@@ -1,0 +1,20 @@
+"""Package metadata (parity: reference setup.py — version, minimal deps)."""
+
+from setuptools import find_packages, setup
+
+setup(
+    name="tensorflowonspark_tpu",
+    version="0.1.0",
+    description=(
+        "TPU-native cluster-federation framework: bring up distributed "
+        "JAX/XLA training from a data-engine scheduler and stream "
+        "partitions into the TPU infeed."
+    ),
+    packages=find_packages(include=["tensorflowonspark_tpu*"]),
+    python_requires=">=3.10",
+    install_requires=["cloudpickle", "numpy"],
+    extras_require={
+        "tpu": ["jax", "optax", "orbax-checkpoint"],
+        "spark": ["pyspark>=3.0"],
+    },
+)

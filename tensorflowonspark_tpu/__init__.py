@@ -1,0 +1,60 @@
+"""TensorFlowOnSpark-TPU: a TPU-native cluster-federation framework.
+
+A ground-up re-design of the capabilities of TensorFlowOnSpark
+(reference: /root/reference, Yahoo TFoS v2.2.1) for TPU hardware and the
+JAX/XLA programming model:
+
+- A data-engine scheduler (Spark, or the built-in local engine) schedules
+  one framework node per executor.
+- A rendezvous server (``rendezvous.py``, parity: reference
+  ``tensorflowonspark/reservation.py``) assembles the cluster spec and the
+  JAX distributed coordinator address instead of a TF_CONFIG.
+- Data-parallel / model-parallel compute runs as SPMD JAX over a
+  ``jax.sharding.Mesh``; collectives ride ICI via XLA (no NCCL/gRPC ring).
+- Spark partitions stream into the accelerator through a batched
+  shared-queue feed (``feed.DataFeed``, parity: reference ``TFNode.py``)
+  rather than per-record pickle IPC.
+
+Public API (mirrors the reference's import surface so users can switch):
+
+    from tensorflowonspark_tpu import TFCluster, TFNode, InputMode
+    cluster = TFCluster.run(sc, main_fun, args, num_executors, ...)
+    cluster.train(dataRDD); cluster.shutdown()
+"""
+
+import logging
+
+__version__ = "0.1.0"
+
+# Library-polite logging: a NullHandler on our namespace; applications (and
+# the example drivers) opt in to the reference's root format by calling
+# configure_logging() (parity intent: reference __init__.py:1-5, which did
+# basicConfig at import time — deliberately not reproduced).
+logging.getLogger(__name__).addHandler(logging.NullHandler())
+
+
+def configure_logging(level=logging.INFO):
+    logging.basicConfig(
+        level=level,
+        format="%(asctime)s %(levelname)s (%(threadName)s-%(process)d) %(message)s",
+    )
+
+_LAZY = {
+    "InputMode": ("tensorflowonspark_tpu.cluster", "InputMode"),
+    "TFCluster": ("tensorflowonspark_tpu.cluster", "TFCluster"),
+    "TFNode": ("tensorflowonspark_tpu.feed", None),
+    "TFNodeContext": ("tensorflowonspark_tpu.node", "TFNodeContext"),
+    "TFParallel": ("tensorflowonspark_tpu.parallel_run", None),
+    "dfutil": ("tensorflowonspark_tpu.dfutil", None),
+    "pipeline": ("tensorflowonspark_tpu.pipeline", None),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        module, attr = _LAZY[name]
+        mod = importlib.import_module(module)
+        return getattr(mod, attr) if attr else mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

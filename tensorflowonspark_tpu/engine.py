@@ -1,0 +1,348 @@
+"""Execution-engine substrate: the scheduler the framework federates.
+
+The reference federates Apache Spark: executors are long-lived *processes*,
+tasks are serialized closures shipped to them, and data is partitioned
+RDDs (SURVEY.md §1 L1).  This module provides the same substrate contract
+behind a small interface so the rest of the framework is
+scheduler-agnostic:
+
+- ``LocalEngine`` — a built-in multi-process executor pool.  This is both
+  the test fixture (parity: reference test/run_tests.sh's 2-worker local
+  Spark Standalone cluster — "Local mode is explicitly insufficient;
+  executors must be separate processes", test/README.md:10) and a real
+  single-host runtime for TPU VMs without a Spark installation.
+- ``SparkEngine`` — a thin adapter over a live ``pyspark.SparkContext``
+  (import-gated; pyspark is optional).
+
+Engine contract used by cluster.py / node.py:
+  ``parallelize(seq, n)`` → Dataset with ``foreach_partition`` /
+  ``map_partitions`` / ``collect`` / ``union`` / ``num_partitions``;
+  ``cancel_all_jobs()``; ``default_fs``; ``num_executors``.
+
+Scheduling model of ``LocalEngine`` (matches how Spark behaves under the
+reference's usage):
+
+- Node-placement jobs run ``spread=True``: task *i* goes to executor *i*'s
+  private inbox — one node per executor, like ``nodeRDD =
+  sc.parallelize(range(N), N)`` spreading over N single-slot workers.
+- Data/feeder jobs go to a shared work-stealing queue: only executors
+  whose slot is free pull them.  A ps/evaluator node task that blocks its
+  slot (reference TFSparkNode.py:411-438) therefore never receives feeder
+  partitions — exactly the emergent Spark behavior the reference relies
+  on.
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import os
+import queue as _queue
+import shutil
+import tempfile
+import threading
+import time
+import traceback
+import multiprocessing as mp
+
+import cloudpickle
+
+logger = logging.getLogger(__name__)
+
+
+class TaskError(RuntimeError):
+    """A task raised on an executor; carries the remote traceback."""
+
+
+# ----------------------------------------------------------------------------
+# Executor worker process
+# ----------------------------------------------------------------------------
+
+def _executor_main(index, workdir, shared_inbox, own_inbox, results):
+    """Executor process loop: pull a task, run it, report the result."""
+    os.chdir(workdir)
+    os.environ["TFOS_EXECUTOR_INDEX"] = str(index)
+    while True:
+        msg = None
+        # Prefer directly-assigned tasks; otherwise steal from the pool.
+        try:
+            msg = own_inbox.get(timeout=0.02)
+        except _queue.Empty:
+            try:
+                msg = shared_inbox.get(timeout=0.02)
+            except _queue.Empty:
+                continue
+        if msg[0] == "stop":
+            break
+        _, job_id, task_id, blob = msg
+        try:
+            fn, items, collect = cloudpickle.loads(blob)
+            out = fn(iter(items))
+            result = list(out) if (collect and out is not None) else None
+            results.put(("ok", job_id, task_id, index, result))
+        except BaseException:  # noqa: BLE001 - must report any task failure
+            results.put(("error", job_id, task_id, index, traceback.format_exc()))
+
+
+# ----------------------------------------------------------------------------
+# Dataset (RDD parity surface)
+# ----------------------------------------------------------------------------
+
+class LocalDataset:
+    """Partitioned dataset with a lazy map_partitions lineage (RDD parity)."""
+
+    def __init__(self, engine, partitions, lineage=None):
+        self._engine = engine
+        self._partitions = partitions  # list[list] or None when derived
+        self._lineage = lineage        # (parent: LocalDataset, fn)
+
+    # -- lineage resolution ---------------------------------------------------
+    def _resolve(self):
+        """Return (base_partitions, composed_fn or None)."""
+        if self._lineage is None:
+            return self._partitions, None
+        parent, fn = self._lineage
+        base, parent_fn = parent._resolve()
+        if parent_fn is None:
+            return base, fn
+
+        def composed(it, _pf=parent_fn, _f=fn):
+            return _f(iter(list(_pf(it))))
+
+        return base, composed
+
+    # -- RDD-like API ---------------------------------------------------------
+    @property
+    def num_partitions(self):
+        base, _ = self._resolve()
+        return len(base)
+
+    def map_partitions(self, fn):
+        return LocalDataset(self._engine, None, lineage=(self, fn))
+
+    def foreach_partition(self, fn, spread=False):
+        base, chain = self._resolve()
+        if chain is not None:
+            def run(it, _c=chain, _f=fn):
+                _f(iter(list(_c(it))))
+                return None
+        else:
+            run = fn
+        self._engine._run_job(base, run, collect=False, spread=spread)
+
+    def collect(self):
+        base, chain = self._resolve()
+        fn = chain if chain is not None else (lambda it: list(it))
+        parts = self._engine._run_job(base, fn, collect=True, spread=False)
+        out = []
+        for p in parts:
+            out.extend(p or [])
+        return out
+
+    def union(self, *others):
+        base, chain = self._resolve()
+        assert chain is None, "union on derived datasets not supported"
+        parts = list(base)
+        for o in others:
+            obase, ochain = o._resolve()
+            assert ochain is None
+            parts.extend(obase)
+        return LocalDataset(self._engine, parts)
+
+
+# ----------------------------------------------------------------------------
+# Local engine
+# ----------------------------------------------------------------------------
+
+class LocalEngine:
+    """Multi-process executor pool: the built-in scheduler substrate."""
+
+    def __init__(self, num_executors, workdir=None, start_method="spawn"):
+        self.num_executors = int(num_executors)
+        self._ctx = mp.get_context(start_method)
+        self._root = workdir or tempfile.mkdtemp(prefix="tfos_engine_")
+        self._owns_root = workdir is None
+        self._shared_inbox = self._ctx.Queue()
+        self._results = self._ctx.Queue()
+        self._own_inboxes = []
+        self._procs = []
+        self._job_counter = 0
+        self._job_lock = threading.Lock()
+        self._cancelled = False
+        self.executor_dirs = []
+        for i in range(self.num_executors):
+            d = os.path.join(self._root, f"executor-{i}")
+            os.makedirs(d, exist_ok=True)
+            self.executor_dirs.append(d)
+            inbox = self._ctx.Queue()
+            self._own_inboxes.append(inbox)
+            # NOT daemonic: executors must be able to fork the background
+            # training process and the IPC manager (Spark executors can).
+            p = self._ctx.Process(
+                target=_executor_main,
+                args=(i, d, self._shared_inbox, inbox, self._results),
+                name=f"tfos-executor-{i}",
+                daemon=False,
+            )
+            p.start()
+            self._procs.append(p)
+        atexit.register(self.stop)
+        logger.info(
+            "LocalEngine started %d executors under %s", self.num_executors, self._root
+        )
+
+    # -- engine contract ------------------------------------------------------
+    @property
+    def default_fs(self):
+        return "file://"
+
+    def parallelize(self, seq, num_partitions=None):
+        items = list(seq)
+        n = num_partitions or self.num_executors
+        n = max(1, min(n, max(len(items), 1)))
+        parts = [[] for _ in range(n)]
+        for i, item in enumerate(items):
+            parts[i * n // max(len(items), 1)].append(item)
+        return LocalDataset(self, parts)
+
+    def from_partitions(self, partitions):
+        return LocalDataset(self, [list(p) for p in partitions])
+
+    def cancel_all_jobs(self):
+        """Abort everything (parity: sc.cancelAllJobs before driver exit)."""
+        self._cancelled = True
+
+    def _run_job(self, partitions, fn, collect, spread):
+        """Dispatch one task per partition; block until all complete."""
+        if self._cancelled:
+            raise TaskError("engine cancelled")
+        with self._job_lock:
+            self._job_counter += 1
+            job_id = self._job_counter
+        # Only executors that die DURING this job abort it; one already lost
+        # to an earlier job must not fail work the survivors can finish.
+        dead_at_start = {i for i, p in enumerate(self._procs) if not p.is_alive()}
+        ntasks = len(partitions)
+        for task_id, part in enumerate(partitions):
+            blob = cloudpickle.dumps((fn, list(part), collect))
+            msg = ("task", job_id, task_id, blob)
+            if spread:
+                self._own_inboxes[task_id % self.num_executors].put(msg)
+            else:
+                self._shared_inbox.put(msg)
+        results = [None] * ntasks
+        done = 0
+        while done < ntasks:
+            if self._cancelled:
+                raise TaskError("engine cancelled")
+            try:
+                status, jid, tid, _idx, payload = self._results.get(timeout=0.25)
+            except _queue.Empty:
+                dead = [
+                    i
+                    for i, p in enumerate(self._procs)
+                    if i not in dead_at_start and not p.is_alive()
+                ]
+                if dead:
+                    raise TaskError(
+                        f"executor(s) {dead} died with tasks in flight "
+                        f"(job {job_id}, {ntasks - done} pending); driver "
+                        "scripts must guard entry with if __name__ == '__main__' "
+                        "when using the default spawn start method"
+                    )
+                continue
+            if jid != job_id:
+                continue  # stale result from a cancelled/failed earlier job
+            if status == "error":
+                raise TaskError(f"task {tid} failed on executor:\n{payload}")
+            results[tid] = payload
+            done += 1
+        return results
+
+    def stop(self):
+        if getattr(self, "_stopped", False):
+            return
+        self._stopped = True
+        for inbox in self._own_inboxes:
+            try:
+                inbox.put(("stop",))
+            except (OSError, ValueError):
+                pass
+        deadline = time.time() + 5
+        for p in self._procs:
+            p.join(timeout=max(0.1, deadline - time.time()))
+            if p.is_alive():
+                p.terminate()
+        if self._owns_root:
+            shutil.rmtree(self._root, ignore_errors=True)
+
+
+# ----------------------------------------------------------------------------
+# Spark adapter (optional)
+# ----------------------------------------------------------------------------
+
+class SparkDataset:
+    """RDD wrapper exposing the Dataset contract."""
+
+    def __init__(self, rdd):
+        self.rdd = rdd
+
+    @property
+    def num_partitions(self):
+        return self.rdd.getNumPartitions()
+
+    def map_partitions(self, fn):
+        return SparkDataset(self.rdd.mapPartitions(fn))
+
+    def foreach_partition(self, fn, spread=False):
+        self.rdd.foreachPartition(fn)
+
+    def collect(self):
+        return self.rdd.collect()
+
+    def union(self, *others):
+        rdd = self.rdd
+        for o in others:
+            rdd = rdd.union(o.rdd if isinstance(o, SparkDataset) else o)
+        return SparkDataset(rdd)
+
+
+class SparkEngine:
+    """Adapter over pyspark.SparkContext (parity: the reference's `sc`)."""
+
+    def __init__(self, sc):
+        self.sc = sc
+        self.num_executors = int(sc.getConf().get("spark.executor.instances", "1"))
+
+    @property
+    def default_fs(self):
+        return self.sc._jsc.hadoopConfiguration().get("fs.defaultFS")
+
+    def parallelize(self, seq, num_partitions=None):
+        return SparkDataset(self.sc.parallelize(seq, num_partitions))
+
+    def cancel_all_jobs(self):
+        self.sc.cancelAllJobs()
+
+    def stop(self):
+        pass  # caller owns the SparkContext
+
+
+def as_engine(obj):
+    """Coerce a SparkContext / RDD-owner / engine to the Engine contract."""
+    if isinstance(obj, (LocalEngine, SparkEngine)):
+        return obj
+    cls = type(obj)
+    if cls.__module__.startswith("pyspark") and cls.__name__ == "SparkContext":
+        return SparkEngine(obj)
+    raise TypeError(f"not an engine or SparkContext: {obj!r}")
+
+
+def as_dataset(obj, engine=None):
+    """Coerce an RDD or Dataset to the Dataset contract."""
+    if isinstance(obj, (LocalDataset, SparkDataset)):
+        return obj
+    cls = type(obj)
+    if cls.__module__.startswith("pyspark") and cls.__name__ == "RDD":
+        return SparkDataset(obj)
+    raise TypeError(f"not a dataset or RDD: {obj!r}")

@@ -1,0 +1,109 @@
+"""Per-executor IPC manager (parity: reference TFManager.py).
+
+A ``multiprocessing.managers.BaseManager`` singleton per executor exposing
+named joinable queues plus a key/value store.  Two modes, exactly like the
+reference (TFManager.py:40-65):
+
+- ``'local'``  — loopback TCP, reachable only from processes on this host
+  (the Spark/engine feeder task and the training process share the
+  executor).
+- ``'remote'`` — bound on all interfaces so the *driver* can connect to
+  push control messages (used for ps/evaluator shutdown, parity:
+  TFCluster.py:186-194).
+
+Differences from the reference:
+- Queue payloads are **batches** (lists of records) pushed by the feeder,
+  not single records; the per-record pickle hop at reference
+  TFSparkNode.py:480-482 was the documented throughput bottleneck
+  (SURVEY.md §3.2).
+- The KV store values go through plain dict semantics; state machine keys
+  ('state': running/terminating/stopped) are identical.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue as _queue
+from multiprocessing.managers import BaseManager, DictProxy
+
+logger = logging.getLogger(__name__)
+
+
+class JoinableItemQueue(_queue.Queue):
+    """A joinable queue living inside the manager process.
+
+    ``multiprocessing.JoinableQueue`` cannot be served by a BaseManager
+    proxy cleanly across independent client processes; a plain
+    ``queue.Queue`` (which *is* joinable via task_done/join) held in the
+    manager server process gives identical semantics over proxies.
+    """
+
+
+class TFManager(BaseManager):
+    """Typed manager; proxies registered at start/connect time.
+
+    ``get``/``set`` are real instance methods over a DictProxy-backed KV
+    store: registering raw callables would hand back AutoProxy objects
+    whose ``==`` never matches plain values.
+    """
+
+    def get(self, key):
+        return self.kv().get(key)
+
+    def set(self, key, value):
+        self.kv().update({key: value})
+
+
+# Server-side singletons (one manager process per executor).  Queues are
+# created lazily *inside the manager server process* on first access: under
+# a spawn start method the server re-imports this module, so parent-side
+# pre-population would be invisible to it.
+_mgr = None
+_qdict = {}
+_kdict = {}
+
+
+def _get_queue(name):
+    if name not in _qdict:
+        _qdict[name] = JoinableItemQueue()
+    return _qdict[name]
+
+
+def _get_kv():
+    return _kdict
+
+
+def start(authkey, queues, mode="local"):
+    """Start this executor's manager (parity: TFManager.py:40-65).
+
+    Args:
+      authkey: shared-secret bytes for connection auth.
+      queues: queue names to create ('input', 'output', 'error', 'control').
+      mode: 'local' (loopback) or 'remote' (any interface, for driver
+        control of ps/evaluator nodes).
+
+    Returns the started ``TFManager`` (its ``.address`` is (host, port)).
+    """
+    global _mgr
+    TFManager.register("get_queue", callable=_get_queue)
+    TFManager.register("kv", callable=_get_kv, proxytype=DictProxy)
+    host = "localhost" if mode == "local" else ""
+    _mgr = TFManager(address=(host, 0), authkey=authkey)
+    _mgr.start()
+    for name in queues:  # pre-warm so queues exist before any consumer
+        _mgr.get_queue(name)
+    _mgr.set("state", "running")
+    logger.info("started TFManager on %s (mode=%s)", _mgr.address, mode)
+    return _mgr
+
+
+def connect(address, authkey):
+    """Connect to a running manager (parity: TFManager.py:68-83)."""
+    TFManager.register("get_queue")
+    TFManager.register("kv", proxytype=DictProxy)
+    if not isinstance(authkey, bytes):
+        authkey = bytes(authkey, "utf-8")
+    m = TFManager(address=tuple(address), authkey=authkey)
+    m.connect()
+    return m

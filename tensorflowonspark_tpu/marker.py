@@ -1,0 +1,18 @@
+"""Sentinel types placed in data queues (parity: reference marker.py:11-18).
+
+``None`` in a queue still means end-of-feed, by convention, exactly as in
+the reference.  Because our queues carry *batches* (lists of records), the
+sentinels are distinguishable from data without isinstance checks on every
+record.
+"""
+
+
+class Marker:
+    """Base class for data-queue sentinels."""
+
+
+class EndPartition(Marker):
+    """Marks the end of one input partition (flush partial batch)."""
+
+    def __repr__(self):
+        return "EndPartition()"

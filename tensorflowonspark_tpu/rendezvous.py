@@ -1,0 +1,281 @@
+"""Cluster rendezvous: TCP registry that assembles the TPU job topology.
+
+Parity target: reference ``tensorflowonspark/reservation.py`` (Server/Client
+with REG/QUERY/QINFO/STOP messages, 1s client polling, env-pinned host/port
+with port ranges, retry logic).  Differences, by design:
+
+- Messages are length-prefixed **JSON**, not pickle (reservation.py:68-97
+  frames pickled dicts; pickle over TCP is an RCE hazard, and node metadata
+  is plain data anyway).
+- What the registry *produces* is not a TF_CONFIG host:port cluster spec but
+  the inputs for ``jax.distributed.initialize``: a coordinator address
+  (process 0), ``num_processes`` and a deterministic ``process_id`` per node
+  (sorted by executor_id, like reservation-sorted cluster specs at reference
+  TFSparkNode.py:43-56).
+
+Env overrides (parity: reservation.py:25-26,190-206):
+  ``TFOS_SERVER_HOST``  — bind/advertise host for the server.
+  ``TFOS_SERVER_PORT``  — port, comma list, and/or ``lo-hi`` ranges.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import select
+import socket
+import struct
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+TFOS_SERVER_HOST = "TFOS_SERVER_HOST"
+TFOS_SERVER_PORT = "TFOS_SERVER_PORT"
+
+MAX_RETRIES = 3          # client connect retries (parity: reservation.py:28)
+POLL_SECS = 1.0          # client await poll interval
+DEFAULT_TIMEOUT = 600    # driver-side await timeout (parity: TFCluster.py:231)
+
+_HEADER = struct.Struct(">I")
+
+
+def _candidate_ports():
+    """Yield candidate ports from TFOS_SERVER_PORT ('p', 'p1,p2', 'lo-hi')."""
+    spec = os.environ.get(TFOS_SERVER_PORT)
+    if not spec:
+        yield 0
+        return
+    for part in str(spec).split(","):
+        part = part.strip()
+        if "-" in part:
+            lo, hi = part.split("-", 1)
+            for p in range(int(lo), int(hi) + 1):
+                yield p
+        elif part:
+            yield int(part)
+
+
+class Reservations:
+    """Thread-safe store of node registrations (parity: reservation.py:31-65)."""
+
+    def __init__(self, required):
+        self.required = int(required)
+        self._lock = threading.RLock()
+        self._reservations = []
+
+    def add(self, meta):
+        with self._lock:
+            self._reservations.append(meta)
+
+    def done(self):
+        with self._lock:
+            return len(self._reservations) >= self.required
+
+    def get(self):
+        with self._lock:
+            return list(self._reservations)
+
+    def remaining(self):
+        with self._lock:
+            return self.required - len(self._reservations)
+
+
+class MessageSocket:
+    """Length-prefixed JSON datagrams over a stream socket."""
+
+    def receive(self, sock):
+        header = self._recv_exact(sock, _HEADER.size)
+        if header is None:
+            return None
+        (length,) = _HEADER.unpack(header)
+        payload = self._recv_exact(sock, length)
+        if payload is None:
+            return None
+        return json.loads(payload.decode("utf-8"))
+
+    def send(self, sock, msg):
+        payload = json.dumps(msg).encode("utf-8")
+        sock.sendall(_HEADER.pack(len(payload)) + payload)
+
+    @staticmethod
+    def _recv_exact(sock, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+
+class Server(MessageSocket):
+    """Rendezvous server run on the driver (parity: reservation.py:100-231)."""
+
+    def __init__(self, count):
+        self.reservations = Reservations(count)
+        self.done = threading.Event()
+        self._listener = None
+        self._thread = None
+
+    def start(self):
+        """Bind, spawn the select() loop thread, return (host, port)."""
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        host = os.environ.get(TFOS_SERVER_HOST) or ""
+        last_err = None
+        for port in _candidate_ports():
+            try:
+                listener.bind((host, port))
+                break
+            except OSError as e:  # try next candidate port
+                last_err = e
+        else:
+            listener.close()
+            raise OSError(f"no usable port from {TFOS_SERVER_PORT}: {last_err}")
+        listener.listen(64)
+        bound_host, bound_port = listener.getsockname()[:2]
+        advertise = os.environ.get(TFOS_SERVER_HOST) or _local_ip()
+        self._listener = listener
+        self._thread = threading.Thread(
+            target=self._serve, name="rendezvous-server", daemon=True
+        )
+        self._thread.start()
+        addr = (advertise, bound_port)
+        logger.info("rendezvous server listening on %s", addr)
+        return addr
+
+    def _serve(self):
+        conns = [self._listener]
+        while not self.done.is_set():
+            try:
+                readable, _, _ = select.select(conns, [], [], 0.25)
+            except OSError:
+                break
+            for sock in readable:
+                if sock is self._listener:
+                    try:
+                        conn, _ = self._listener.accept()
+                        # A stalled/fragmented client must not freeze the
+                        # whole select loop in a blocking recv.
+                        conn.settimeout(10.0)
+                        conns.append(conn)
+                    except OSError:
+                        pass
+                    continue
+                try:
+                    msg = self.receive(sock)
+                except (OSError, TimeoutError, ValueError):
+                    msg = None
+                if msg is None:
+                    conns.remove(sock)
+                    sock.close()
+                    continue
+                self._handle_message(sock, msg)
+        for sock in conns:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _handle_message(self, sock, msg):
+        """REG/QUERY/QINFO/QNUM/STOP (parity: reservation.py:130-146)."""
+        kind = msg.get("type")
+        if kind == "REG":
+            self.reservations.add(msg["data"])
+            self.send(sock, {"type": "OK"})
+        elif kind == "QUERY":
+            self.send(sock, {"type": "QUERY", "data": self.reservations.done()})
+        elif kind == "QINFO":
+            self.send(sock, {"type": "QINFO", "data": self.reservations.get()})
+        elif kind == "QNUM":
+            self.send(sock, {"type": "QNUM", "data": self.reservations.remaining()})
+        elif kind == "STOP":
+            self.send(sock, {"type": "OK"})
+            self.done.set()
+        else:
+            self.send(sock, {"type": "ERR", "data": f"unknown message {kind!r}"})
+
+    def await_reservations(self, status=None, timeout=DEFAULT_TIMEOUT):
+        """Block until every node registered (parity: reservation.py:113-128).
+
+        ``status`` is the shared driver-side dict; an 'error' key set by the
+        launcher thread aborts the wait (parity: TFCluster.py tf_status).
+        """
+        deadline = time.time() + timeout
+        while not self.reservations.done():
+            if status and status.get("error"):
+                raise RuntimeError(f"node startup failed: {status['error']}")
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"timed out waiting for {self.reservations.remaining()} "
+                    f"of {self.reservations.required} reservations"
+                )
+            time.sleep(0.1)
+        return self.reservations.get()
+
+    def stop(self):
+        self.done.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class Client(MessageSocket):
+    """Node-side rendezvous client (parity: reservation.py:234-301)."""
+
+    def __init__(self, server_addr):
+        self.server_addr = (server_addr[0], int(server_addr[1]))
+        self._sock = self._connect()
+
+    def _connect(self):
+        last = None
+        for attempt in range(MAX_RETRIES):
+            try:
+                return socket.create_connection(self.server_addr, timeout=30)
+            except OSError as e:
+                last = e
+                time.sleep(2 ** attempt)
+        raise ConnectionError(
+            f"cannot reach rendezvous server at {self.server_addr}: {last}"
+        )
+
+    def _call(self, msg):
+        self.send(self._sock, msg)
+        reply = self.receive(self._sock)
+        if reply is None:
+            raise ConnectionError("rendezvous server closed connection")
+        return reply
+
+    def register(self, node_meta):
+        return self._call({"type": "REG", "data": node_meta})
+
+    def get_reservations(self):
+        return self._call({"type": "QINFO"})["data"]
+
+    def await_reservations(self, timeout=DEFAULT_TIMEOUT):
+        """Poll until the cluster is complete, then return all node metas."""
+        deadline = time.time() + timeout
+        while not self._call({"type": "QUERY"})["data"]:
+            if time.time() > deadline:
+                raise TimeoutError("timed out awaiting cluster completion")
+            time.sleep(POLL_SECS)
+        return self.get_reservations()
+
+    def request_stop(self):
+        try:
+            return self._call({"type": "STOP"})
+        finally:
+            self.close()
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _local_ip():
+    from tensorflowonspark_tpu.utils import get_ip_address
+
+    return get_ip_address()

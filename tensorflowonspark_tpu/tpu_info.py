@@ -1,0 +1,117 @@
+"""TPU chip discovery and per-worker arbitration (parity: reference gpu_info.py).
+
+The reference polls ``nvidia-smi`` for free GPUs and assigns them by worker
+index when several executors share a host (gpu_info.py:31-98).  On TPU VMs
+the equivalent questions are:
+
+- *are there chips here?*  → ``/dev/accel*`` / ``/dev/vfio`` device nodes,
+  or a live JAX TPU backend;
+- *which chips may THIS process use?* → libtpu visible-chip env vars
+  (``TPU_VISIBLE_CHIPS`` + process-bounds), the TPU analogue of
+  ``CUDA_VISIBLE_DEVICES`` index placement at gpu_info.py:81-91.
+
+All discovery goes through module-level functions so tests can patch them
+exactly the way the reference tests patch ``gpu_info.get_gpus``
+(test_TFSparkNode.py:49-187).
+"""
+
+from __future__ import annotations
+
+import glob
+import logging
+import os
+import time
+
+logger = logging.getLogger(__name__)
+
+MAX_RETRIES = 3  # parity: gpu_info.py:17
+
+
+def is_tpu_available():
+    """True if this host has TPU chips (parity: gpu_info.is_gpu_available)."""
+    return count_chips() > 0
+
+
+def count_chips():
+    """Number of TPU chips attached to this host.
+
+    Honors ``TFOS_TPU_CHIPS_PER_HOST`` as an override (tests / forced
+    topologies), else counts accelerator device nodes.
+    """
+    override = os.environ.get("TFOS_TPU_CHIPS_PER_HOST")
+    if override:
+        return int(override)
+    return len(glob.glob("/dev/accel*")) or len(glob.glob("/dev/vfio/[0-9]*"))
+
+
+def get_chips(num_chips, worker_index=-1):
+    """Claim ``num_chips`` chips for this worker; returns chip indices.
+
+    With ``worker_index >= 0`` and multiple workers per host, each worker
+    takes a disjoint contiguous block (index-based placement, parity:
+    gpu_info.py:81-91).  Retries with linear backoff like the reference's
+    busy-GPU retry loop (gpu_info.py:58-80).
+    """
+    if num_chips <= 0:
+        return []
+    for attempt in range(1, MAX_RETRIES + 1):
+        available = count_chips()
+        if available >= num_chips:
+            if worker_index < 0:
+                chips = list(range(num_chips))
+            else:
+                base = worker_index * num_chips
+                if base + num_chips > available:
+                    raise RuntimeError(
+                        f"worker {worker_index} needs chips "
+                        f"[{base}, {base + num_chips}) but host has only "
+                        f"{available}; total per-host demand exceeds supply"
+                    )
+                chips = list(range(base, base + num_chips))
+            logger.info(
+                "claimed TPU chips %s (worker_index=%d, host has %d)",
+                chips, worker_index, available,
+            )
+            return chips
+        if attempt < MAX_RETRIES:
+            wait = 30 * attempt
+            logger.warning(
+                "requested %d TPU chips, host reports %d; retry %d/%d in %ds",
+                num_chips, available, attempt, MAX_RETRIES, wait,
+            )
+            time.sleep(wait)
+    raise RuntimeError(
+        f"unable to claim {num_chips} TPU chips (host has {count_chips()})"
+    )
+
+
+def set_visible_chips(num_chips, worker_index=-1):
+    """Export visible-chip env so the TPU runtime scopes this process.
+
+    TPU analogue of exporting ``CUDA_VISIBLE_DEVICES``
+    (gpu_info.py format='CUDA' path).  Must run before jax initializes.
+    """
+    chips = get_chips(num_chips, worker_index)
+    os.environ["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in chips)
+    os.environ["TPU_CHIPS_PER_PROCESS_BOUNDS"] = f"1,{len(chips)},1"
+    os.environ["TPU_PROCESS_BOUNDS"] = "1,1,1"
+    return chips
+
+
+def local_device_info():
+    """Describe local accelerators from a live JAX backend (best-effort)."""
+    try:
+        import jax
+
+        devs = jax.local_devices()
+        return [
+            {
+                "id": d.id,
+                "platform": d.platform,
+                "kind": getattr(d, "device_kind", "unknown"),
+            }
+            for d in devs
+        ]
+    except Exception as e:  # noqa: BLE001 - discovery is best-effort
+        logger.debug("no live jax backend for device info: %s", e)
+        return []

@@ -1,0 +1,9 @@
+"""Small host-side utilities (parity: reference tensorflowonspark/util.py)."""
+
+from tensorflowonspark_tpu.utils.hostinfo import (  # noqa: F401
+    find_in_path,
+    get_ip_address,
+    read_executor_id,
+    single_node_env,
+    write_executor_id,
+)

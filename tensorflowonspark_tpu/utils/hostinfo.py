@@ -1,0 +1,79 @@
+"""Host discovery, env setup, executor-id persistence.
+
+Parity: reference tensorflowonspark/util.py:21-94.  The executor-id file is
+the key that lets a *feeder* task, scheduled later onto the same executor,
+reattach to the manager started by the node task (SURVEY.md §3.2).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+
+logger = logging.getLogger(__name__)
+
+_EXECUTOR_ID_FILE = "executor_id"
+
+
+def get_ip_address():
+    """This host's primary IP via the UDP-connect trick (util.py:52-65)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 80))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def find_in_path(path, file_name):
+    """Find file_name in the os.pathsep-separated path (util.py:68-74)."""
+    for p in path.split(os.pathsep):
+        candidate = os.path.join(p, file_name)
+        if os.path.exists(candidate) and os.path.isfile(candidate):
+            return candidate
+    return False
+
+
+def write_executor_id(num, cwd=None):
+    """Persist this executor's id in its working dir (util.py:77-85)."""
+    path = os.path.join(cwd or os.getcwd(), _EXECUTOR_ID_FILE)
+    with open(path, "w") as f:
+        f.write(str(num))
+    return path
+
+
+def read_executor_id(cwd=None):
+    """Read back the executor id; None if the node task never ran here."""
+    path = os.path.join(cwd or os.getcwd(), _EXECUTOR_ID_FILE)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return int(f.read())
+
+
+def single_node_env(num_chips=0, worker_index=-1):
+    """Set up a single-node environment (util.py:21-49 equivalent).
+
+    The reference expands Hadoop classpath globs and claims GPUs via
+    nvidia-smi; here the device substrate is the TPU runtime, so this
+    partitions visible TPU chips for multi-process-per-host placement.
+    """
+    from tensorflowonspark_tpu import tpu_info
+
+    if num_chips > 0:
+        tpu_info.set_visible_chips(num_chips, worker_index)
+    # Expand any HADOOP classpath for HDFS-backed checkpoint paths, once.
+    if "HADOOP_PREFIX" in os.environ and "TFOS_CLASSPATH_UPDATED" not in os.environ:
+        classpath = os.environ.get("CLASSPATH", "")
+        hadoop_path = os.path.join(os.environ["HADOOP_PREFIX"], "bin", "hadoop")
+        if os.path.exists(hadoop_path):
+            import subprocess
+
+            hadoop_classpath = subprocess.check_output(
+                [hadoop_path, "classpath", "--glob"]
+            ).decode()
+            os.environ["CLASSPATH"] = classpath + os.pathsep + hadoop_classpath
+        os.environ["TFOS_CLASSPATH_UPDATED"] = "1"

@@ -1,0 +1,27 @@
+"""Test fixtures: force an 8-device virtual CPU platform before jax imports.
+
+Mirrors the reference test strategy (reference test/run_tests.sh boots a
+2-worker local Spark Standalone cluster): we test multi-chip sharding with
+multiple *virtual* devices on one host, and multi-node behavior with
+multiple executor *processes* on one host.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

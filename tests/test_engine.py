@@ -1,0 +1,105 @@
+"""LocalEngine tests: the substrate must behave like Spark executors do."""
+
+import os
+
+import pytest
+
+from tensorflowonspark_tpu.engine import LocalEngine, TaskError
+
+
+@pytest.fixture()
+def engine():
+    e = LocalEngine(2)
+    yield e
+    e.stop()
+
+
+def _square_sum(it):
+    return [sum(x * x for x in it)]
+
+
+def test_parallelize_collect(engine):
+    ds = engine.parallelize(range(10), 2)
+    assert ds.num_partitions == 2
+    assert sorted(ds.collect()) == sorted(range(10))
+
+
+def test_map_partitions(engine):
+    ds = engine.parallelize(range(1000), 4)
+    out = ds.map_partitions(_square_sum).collect()
+    assert sum(out) == sum(x * x for x in range(1000))
+
+
+def test_map_partitions_chained(engine):
+    ds = engine.parallelize(range(8), 2)
+    out = (
+        ds.map_partitions(lambda it: [x + 1 for x in it])
+        .map_partitions(lambda it: [x * 10 for x in it])
+        .collect()
+    )
+    assert sorted(out) == [10 * (x + 1) for x in range(8)]
+
+
+def test_union(engine):
+    a = engine.parallelize(range(4), 2)
+    b = engine.parallelize(range(4, 8), 2)
+    u = a.union(b)
+    assert u.num_partitions == 4
+    assert sorted(u.collect()) == list(range(8))
+
+
+def test_executors_are_processes(engine):
+    ds = engine.parallelize(range(2), 2)
+    pids = ds.map_partitions(lambda it: [os.getpid()]).collect()
+    assert all(p != os.getpid() for p in pids)
+
+
+def test_spread_puts_one_task_per_executor(engine):
+    ds = engine.parallelize(range(2), 2)
+    seen = []
+
+    def record(it):
+        list(it)
+        with open("touched", "w") as f:
+            f.write(os.environ["TFOS_EXECUTOR_INDEX"])
+
+    ds.foreach_partition(record, spread=True)
+    for d in engine.executor_dirs:
+        with open(os.path.join(d, "touched")) as f:
+            seen.append(f.read())
+    assert sorted(seen) == ["0", "1"]
+
+
+def test_executor_cwd_is_stable(engine):
+    """Feeder tasks must find files written by earlier node tasks."""
+    ds = engine.parallelize(range(2), 2)
+
+    def write(it):
+        list(it)
+        with open("state", "w") as f:
+            f.write("x")
+
+    ds.foreach_partition(write, spread=True)
+    found = (
+        engine.parallelize(range(2), 2)
+        .map_partitions(lambda it: [os.path.exists("state")])
+        .collect()
+    )
+    assert found == [True, True]
+
+
+def test_task_error_propagates(engine):
+    ds = engine.parallelize(range(4), 2)
+
+    def boom(it):
+        raise ValueError("deliberate failure")
+
+    with pytest.raises(TaskError, match="deliberate failure"):
+        ds.foreach_partition(boom)
+
+
+def test_closure_capture(engine):
+    factor = 7
+    ds = engine.parallelize(range(5), 2)
+    out = ds.map_partitions(lambda it: [x * factor for x in it]).collect()
+    assert sorted(out) == [x * 7 for x in range(5)]

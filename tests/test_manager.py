@@ -1,0 +1,48 @@
+"""Executor IPC manager tests (parity: DataFeed/TFManager usage patterns)."""
+
+from tensorflowonspark_tpu import manager as tfmanager
+
+
+def test_queue_roundtrip_and_kv():
+    m = tfmanager.start(b"secret", ["input", "output"])
+    try:
+        assert m.get("state") == "running"
+        m.set("state", "terminating")
+        assert m.get("state") == "terminating"
+        assert m.get("missing") is None
+
+        q = m.get_queue("input")
+        q.put([1, 2, 3])  # a batch
+        assert q.get() == [1, 2, 3]
+        q.task_done()
+
+        # second connection (the feeder-reattach path)
+        c = tfmanager.connect(m.address, b"secret")
+        assert c.get("state") == "terminating"
+        c.get_queue("output").put(["r"])
+        assert m.get_queue("output").get() == ["r"]
+    finally:
+        m.shutdown()
+
+
+def test_queue_join_semantics():
+    m = tfmanager.start(b"secret", ["input"])
+    try:
+        q = m.get_queue("input")
+        q.put(["batch"])
+        import threading
+
+        done = threading.Event()
+
+        def consume():
+            q.get()
+            q.task_done()
+            done.set()
+
+        t = threading.Thread(target=consume)
+        t.start()
+        q.join()  # returns only after task_done
+        assert done.is_set()
+        t.join()
+    finally:
+        m.shutdown()

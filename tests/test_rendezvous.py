@@ -1,0 +1,128 @@
+"""Rendezvous unit tests (parity: reference test/test_reservation.py)."""
+
+import os
+import threading
+from unittest import mock
+
+import pytest
+
+from tensorflowonspark_tpu import rendezvous
+from tensorflowonspark_tpu.rendezvous import Client, Reservations, Server
+
+
+class TestReservations:
+    def test_counting(self):
+        r = Reservations(3)
+        assert not r.done()
+        assert r.remaining() == 3
+        r.add({"node": 0})
+        r.add({"node": 1})
+        assert r.remaining() == 1
+        assert not r.done()
+        r.add({"node": 2})
+        assert r.done()
+        assert r.remaining() == 0
+        assert [m["node"] for m in r.get()] == [0, 1, 2]
+
+
+class TestServerClient:
+    def test_single_registration(self):
+        server = Server(1)
+        addr = server.start()
+        client = Client(addr)
+        client.register({"executor_id": 0, "host": "h", "port": 1234})
+        got = client.await_reservations(timeout=10)
+        assert got == [{"executor_id": 0, "host": "h", "port": 1234}]
+        client.request_stop()
+        assert server.done.wait(5)
+        server.stop()
+
+    def test_concurrent_registration(self):
+        n = 4
+        server = Server(n)
+        addr = server.start()
+
+        def reg(i):
+            c = Client(addr)
+            c.register({"executor_id": i})
+            c.await_reservations(timeout=10)
+            c.close()
+
+        threads = [threading.Thread(target=reg, args=(i,)) for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        got = server.await_reservations(timeout=10)
+        assert sorted(m["executor_id"] for m in got) == list(range(n))
+        server.stop()
+
+    def test_driver_await_sees_error(self):
+        server = Server(2)
+        server.start()
+        status = {"error": "boom"}
+        with pytest.raises(RuntimeError, match="boom"):
+            server.await_reservations(status=status, timeout=5)
+        server.stop()
+
+    def test_driver_await_timeout(self):
+        server = Server(1)
+        server.start()
+        with pytest.raises(TimeoutError):
+            server.await_reservations(timeout=0.3)
+        server.stop()
+
+
+class TestEnvOverrides:
+    def test_fixed_host(self):
+        with mock.patch.dict(os.environ, {rendezvous.TFOS_SERVER_HOST: "127.0.0.1"}):
+            server = Server(1)
+            host, port = server.start()
+            assert host == "127.0.0.1"
+            assert port > 0
+            server.stop()
+
+    def test_port_range(self):
+        with mock.patch.dict(
+            os.environ,
+            {
+                rendezvous.TFOS_SERVER_HOST: "127.0.0.1",
+                rendezvous.TFOS_SERVER_PORT: "27710-27719",
+            },
+        ):
+            s1 = Server(1)
+            _, p1 = s1.start()
+            assert 27710 <= p1 <= 27719
+            s2 = Server(1)
+            _, p2 = s2.start()
+            assert 27710 <= p2 <= 27719 and p2 != p1
+            s1.stop()
+            s2.stop()
+
+    def test_port_list(self):
+        with mock.patch.dict(
+            os.environ,
+            {
+                rendezvous.TFOS_SERVER_HOST: "127.0.0.1",
+                rendezvous.TFOS_SERVER_PORT: "27730,27731",
+            },
+        ):
+            s = Server(1)
+            _, p = s.start()
+            assert p in (27730, 27731)
+            s.stop()
+
+    def test_exhausted_port_range(self):
+        with mock.patch.dict(
+            os.environ,
+            {
+                rendezvous.TFOS_SERVER_HOST: "127.0.0.1",
+                rendezvous.TFOS_SERVER_PORT: "27740",
+            },
+        ):
+            s1 = Server(1)
+            s1.start()
+            s2 = Server(1)
+            with pytest.raises(OSError):
+                s2.start()
+            s1.stop()

@@ -1,0 +1,47 @@
+"""TPU discovery/arbitration tests (parity: reference test_TFSparkNode GPU table)."""
+
+import os
+from unittest import mock
+
+import pytest
+
+from tensorflowonspark_tpu import tpu_info
+
+
+def test_zero_chips_is_noop():
+    assert tpu_info.get_chips(0) == []
+
+
+def test_override_env_count():
+    with mock.patch.dict(os.environ, {"TFOS_TPU_CHIPS_PER_HOST": "4"}):
+        assert tpu_info.count_chips() == 4
+        assert tpu_info.is_tpu_available()
+
+
+def test_worker_index_placement_disjoint():
+    with mock.patch.dict(os.environ, {"TFOS_TPU_CHIPS_PER_HOST": "4"}):
+        assert tpu_info.get_chips(2, worker_index=0) == [0, 1]
+        assert tpu_info.get_chips(2, worker_index=1) == [2, 3]
+
+
+def test_oversubscription_raises():
+    with mock.patch.dict(os.environ, {"TFOS_TPU_CHIPS_PER_HOST": "4"}):
+        with pytest.raises(RuntimeError, match="demand exceeds supply"):
+            tpu_info.get_chips(2, worker_index=2)
+
+
+def test_unavailable_retries_then_raises():
+    with mock.patch.dict(os.environ, {"TFOS_TPU_CHIPS_PER_HOST": "1"}):
+        with mock.patch.object(tpu_info.time, "sleep") as slept:
+            with pytest.raises(RuntimeError, match="unable to claim"):
+                tpu_info.get_chips(2)
+            assert slept.call_count == tpu_info.MAX_RETRIES - 1
+
+
+def test_set_visible_chips_env():
+    with mock.patch.dict(os.environ, {"TFOS_TPU_CHIPS_PER_HOST": "8"}, clear=False):
+        chips = tpu_info.set_visible_chips(4, worker_index=1)
+        assert chips == [4, 5, 6, 7]
+        assert os.environ["TPU_VISIBLE_CHIPS"] == "4,5,6,7"
+        for var in ("TPU_VISIBLE_CHIPS", "TPU_CHIPS_PER_PROCESS_BOUNDS", "TPU_PROCESS_BOUNDS"):
+            os.environ.pop(var, None)
