@@ -1,0 +1,310 @@
+"""Driver-side cluster orchestration (parity: reference TFCluster.py).
+
+``run()`` computes the cluster template (which executor plays ps / chief /
+evaluator / worker), starts the rendezvous server, launches one node per
+executor on a background thread, waits for all registrations, and returns
+a ``TFCluster`` handle with ``train`` / ``inference`` / ``shutdown``.
+
+TPU-native notes:
+- The rendezvous output is JAX-distributed bootstrap info (coordinator
+  address + process ids), not a TF_CONFIG (node.py).
+- ``num_chips`` is the per-executor TPU chip claim (the `num_gpus`
+  analogue).
+- ps/evaluator roles are preserved as API and lifecycle (background
+  process + driver-controlled stop) even though parameter-server training
+  is not idiomatic on TPU; SPMD jobs simply run with num_ps=0.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import secrets
+import sys
+import threading
+import time
+
+from tensorflowonspark_tpu import engine as engine_mod
+from tensorflowonspark_tpu import manager as tfmanager
+from tensorflowonspark_tpu import node, rendezvous
+
+logger = logging.getLogger(__name__)
+
+
+class InputMode:
+    """How the training job ingests data (parity: TFCluster.py:43-46)."""
+
+    TENSORFLOW = 0  #: nodes read their own data (files, tfds, ...)
+    SPARK = 1       #: engine partitions are fed through executor queues
+
+
+# driver-side status shared with the launcher thread (TFCluster.py:40)
+tf_status = {}
+
+
+class TFCluster:
+    sc = None
+    engine = None
+    meta = None
+    nodes = None
+    cluster_info = None
+    cluster_meta = None
+    input_mode = None
+    queues = None
+    server = None
+
+    def train(self, dataset, num_epochs=1, feed_timeout=600, qname="input"):
+        """Feed a dataset into the cluster (parity: TFCluster.train :63-94).
+
+        Epochs are realized by unioning the dataset with itself — the exact
+        reference mechanism (TFCluster.py:88-93).
+        """
+        logger.info("feeding training data")
+        assert self.input_mode == InputMode.SPARK, "train() requires InputMode.SPARK"
+        ds = engine_mod.as_dataset(dataset)
+        assert num_epochs >= 0, "num_epochs cannot be negative"
+        if num_epochs > 1:
+            ds = ds.union(*[ds for _ in range(num_epochs - 1)])
+        # spread=True round-robins partitions across executors so SPMD
+        # consumers see balanced feeds (uneven feeds would stall the
+        # synchronous gradient all-reduce; cf. the reference's "90% of
+        # steps" workaround, examples/mnist/keras/mnist_spark.py:58-66).
+        ds.foreach_partition(
+            node.train(self.cluster_info, self.cluster_meta, feed_timeout, qname),
+            spread=True,
+        )
+
+    def train_stream(self, stream, feed_timeout=600, qname="input"):
+        """Feed a streaming source: an iterable of datasets (micro-batches).
+
+        Parity: DStream.foreachRDD feeding (TFCluster.py:83-85).  Stops
+        gracefully when a consumer calls ``DataFeed.terminate()`` (which
+        makes a feeder send STOP to the rendezvous server).
+        """
+        assert self.input_mode == InputMode.SPARK
+        for micro in stream:
+            if self.server.done.is_set():
+                logger.info("train_stream: STOP received, ending stream feed")
+                break
+            ds = engine_mod.as_dataset(micro)
+            ds.foreach_partition(
+                node.train(self.cluster_info, self.cluster_meta, feed_timeout, qname)
+            )
+
+    def inference(self, dataset, feed_timeout=600, qname="input"):
+        """Map a dataset through the cluster for predictions (lazy)
+        (parity: TFCluster.inference :96-115)."""
+        logger.info("feeding inference data")
+        assert self.input_mode == InputMode.SPARK, "inference() requires InputMode.SPARK"
+        ds = engine_mod.as_dataset(dataset)
+        return ds.map_partitions(
+            node.inference(self.cluster_info, self.cluster_meta, feed_timeout, qname)
+        )
+
+    def shutdown(self, ssc=None, grace_secs=0, timeout=259200):
+        """Stop the cluster and propagate errors
+        (parity: TFCluster.shutdown :117-205)."""
+        logger.info("waiting for cluster to shut down")
+        workers = [
+            m for m in self.cluster_info if m["job_name"] not in ("ps", "evaluator")
+        ]
+        ps_eval = [
+            m for m in self.cluster_info if m["job_name"] in ("ps", "evaluator")
+        ]
+
+        # watchdog (SIGALRM parity, TFCluster.py:136-144) — thread-based so
+        # it also works off the main thread
+        def _watchdog():
+            logger.error("shutdown watchdog fired after %ss; cancelling jobs", timeout)
+            self.engine.cancel_all_jobs()
+            os._exit(1)
+
+        watchdog = threading.Timer(timeout, _watchdog)
+        watchdog.daemon = True
+        watchdog.start()
+        try:
+            # signal end-of-feed on every worker's queues
+            worker_ids = sorted(m["executor_id"] for m in workers)
+            if worker_ids:
+                shutdown_ds = self.engine.parallelize(worker_ids, len(worker_ids))
+                shutdown_ds.foreach_partition(
+                    node.shutdown(
+                        self.cluster_info, self.queues, self.meta["id"], grace_secs
+                    ),
+                    placement=worker_ids,
+                )
+
+            # wait for the node-launcher thread (workers run to completion)
+            if self._launcher is not None:
+                self._launcher.join(timeout=timeout)
+
+            if tf_status.get("error"):
+                logger.error("cluster failed: %s", tf_status["error"])
+                self.engine.cancel_all_jobs()
+                sys.exit(1)
+
+            # drive ps/evaluator to stop via their remote managers
+            # (TFCluster.py:186-194)
+            for m in ps_eval:
+                try:
+                    mgr = tfmanager.connect(
+                        tuple(m["addr"]), bytes.fromhex(m["authkey"])
+                    )
+                    mgr.get_queue("control").put(None, block=True)
+                except Exception as e:  # noqa: BLE001
+                    logger.warning(
+                        "could not stop %s:%s: %s", m["job_name"], m["task_index"], e
+                    )
+        finally:
+            watchdog.cancel()
+            self.server.stop()
+        logger.info("cluster shut down")
+
+    def tensorboard_url(self):
+        """URL of the dashboard node, if one was launched
+        (parity: TFCluster.py:207-212)."""
+        for m in self.cluster_info:
+            if m.get("tb_port"):
+                return f"http://{m['host']}:{m['tb_port']}"
+        return None
+
+    _launcher = None
+
+
+def run(
+    sc,
+    map_fun,
+    tf_args,
+    num_executors,
+    num_ps=0,
+    tensorboard=False,
+    input_mode=InputMode.TENSORFLOW,
+    log_dir=None,
+    driver_ps_nodes=False,
+    master_node=None,
+    reservation_timeout=600,
+    queues=("input", "output", "error", "control"),
+    eval_node=False,
+    num_chips=0,
+    background=None,
+):
+    """Starts the distributed cluster (parity: TFCluster.run :215-383).
+
+    Args mirror the reference; ``sc`` may be a pyspark SparkContext or a
+    ``LocalEngine``.  ``num_chips`` replaces the implicit GPU count.
+    """
+    logger.info("Reserving TFSparkNodes-TPU")
+    eng = engine_mod.as_engine(sc)
+    queues = list(queues)
+
+    if driver_ps_nodes and input_mode != InputMode.TENSORFLOW:
+        raise ValueError("driver_ps_nodes requires InputMode.TENSORFLOW")
+    assert num_ps < num_executors or driver_ps_nodes, (
+        "num_ps must be less than num_executors (or use driver_ps_nodes)"
+    )
+
+    # cluster template {job: [executor_ids]} (TFCluster.py:246-271)
+    cluster_size = num_executors + (num_ps if driver_ps_nodes else 0)
+    ids = list(range(cluster_size))
+    template = {}
+    if driver_ps_nodes:
+        # ps ids live past the engine executors; they run as driver threads
+        template["ps"] = ids[num_executors:]
+        pool = ids[:num_executors]
+    else:
+        if num_ps > 0:
+            template["ps"] = ids[:num_ps]
+        pool = ids[num_ps:]
+    if eval_node:
+        template["evaluator"] = [pool.pop(0)]
+    if master_node:
+        assert master_node in ("chief", "master"), "master_node must be chief|master"
+        template[master_node] = [pool.pop(0)]
+    if pool:
+        template["worker"] = pool
+    logger.info("cluster template: %s", template)
+
+    if background is None:
+        background = input_mode == InputMode.SPARK
+
+    server = rendezvous.Server(cluster_size)
+    server_addr = server.start()
+
+    cluster_meta = {
+        "id": random.getrandbits(64),
+        "cluster_template": template,
+        "num_executors": num_executors,
+        "default_fs": eng.default_fs,
+        "working_dir": os.getcwd(),
+        "server_addr": list(server_addr),
+        "authkey": secrets.token_hex(16),
+        "reservation_timeout": reservation_timeout,
+    }
+
+    tf_status.clear()
+    node_fn = node.run(
+        map_fun,
+        tf_args,
+        cluster_meta,
+        tensorboard=tensorboard,
+        log_dir=log_dir,
+        queues=queues,
+        background=background,
+        num_chips=num_chips,
+    )
+
+    # driver-hosted ps nodes run as local threads (TFCluster.py:296-314)
+    if driver_ps_nodes:
+        def _driver_ps(ps_id):
+            try:
+                node_fn([ps_id])
+            except Exception as e:  # noqa: BLE001
+                tf_status["error"] = str(e)
+
+        for ps_id in template["ps"]:
+            t = threading.Thread(target=_driver_ps, args=(ps_id,), daemon=True)
+            t.start()
+
+    # launch engine-hosted nodes on a background thread (TFCluster.py:317-334)
+    node_ids = sorted(i for i in range(cluster_size)
+                      if not (driver_ps_nodes and i >= num_executors))
+    nodes_ds = eng.parallelize(node_ids, len(node_ids))
+
+    def _launch():
+        try:
+            nodes_ds.foreach_partition(node_fn, placement=node_ids)
+        except Exception as e:  # noqa: BLE001
+            logger.exception("node launch failed")
+            tf_status["error"] = str(e)
+
+    launcher = threading.Thread(target=_launch, daemon=True, name="tfos-launcher")
+    launcher.start()
+
+    # wait for all nodes to register (TFCluster.py:338)
+    cluster_info = server.await_reservations(tf_status, reservation_timeout)
+
+    # duplicate (host, executor_id) sanity check (TFCluster.py:355-370)
+    seen = set()
+    for m in cluster_info:
+        key = (m["host"], m["executor_id"])
+        if key in seen:
+            raise RuntimeError(f"duplicate node registration for {key}")
+        seen.add(key)
+    logger.info("cluster_info: %s", [
+        (m["job_name"], m["task_index"], m["host"], m["executor_id"])
+        for m in cluster_info
+    ])
+
+    c = TFCluster()
+    c.sc = sc
+    c.engine = eng
+    c.meta = cluster_meta
+    c.cluster_meta = cluster_meta
+    c.nodes = nodes_ds
+    c.cluster_info = cluster_info
+    c.input_mode = input_mode
+    c.queues = queues
+    c.server = server
+    c._launcher = launcher
+    return c

@@ -120,7 +120,10 @@ class LocalDataset:
     def map_partitions(self, fn):
         return LocalDataset(self._engine, None, lineage=(self, fn))
 
-    def foreach_partition(self, fn, spread=False):
+    def foreach_partition(self, fn, spread=False, placement=None):
+        """Run fn over partitions.  ``placement`` pins task i to executor
+        placement[i] (used so shutdown signals reach the executor that owns
+        each node's manager — Spark gets this from locality)."""
         base, chain = self._resolve()
         if chain is not None:
             def run(it, _c=chain, _f=fn):
@@ -128,12 +131,16 @@ class LocalDataset:
                 return None
         else:
             run = fn
-        self._engine._run_job(base, run, collect=False, spread=spread)
+        self._engine._run_job(
+            base, run, collect=False, spread=spread, placement=placement
+        )
 
     def collect(self):
         base, chain = self._resolve()
         fn = chain if chain is not None else (lambda it: list(it))
-        parts = self._engine._run_job(base, fn, collect=True, spread=False)
+        parts = self._engine._run_job(
+            base, fn, collect=True, spread=False, placement=None
+        )
         out = []
         for p in parts:
             out.extend(p or [])
@@ -157,9 +164,16 @@ class LocalDataset:
 class LocalEngine:
     """Multi-process executor pool: the built-in scheduler substrate."""
 
-    def __init__(self, num_executors, workdir=None, start_method="spawn"):
+    def __init__(self, num_executors, workdir=None, start_method="spawn", env=None):
+        """``env``: environment overrides for executor processes (set at
+        spawn time so they apply before the child interpreter boots —
+        required for platform-selection vars like JAX_PLATFORMS).  A value
+        of None removes the variable.  Construction briefly mutates
+        os.environ, so construct engines from the driver main thread
+        before launching other threads/subprocesses."""
         self.num_executors = int(num_executors)
         self._ctx = mp.get_context(start_method)
+        self._env = dict(env) if env else {}
         self._root = workdir or tempfile.mkdtemp(prefix="tfos_engine_")
         self._owns_root = workdir is None
         self._shared_inbox = self._ctx.Queue()
@@ -168,24 +182,46 @@ class LocalEngine:
         self._procs = []
         self._job_counter = 0
         self._job_lock = threading.Lock()
+        self._job_queues = {}  # job_id -> local queue (results demux)
         self._cancelled = False
         self.executor_dirs = []
-        for i in range(self.num_executors):
-            d = os.path.join(self._root, f"executor-{i}")
-            os.makedirs(d, exist_ok=True)
-            self.executor_dirs.append(d)
-            inbox = self._ctx.Queue()
-            self._own_inboxes.append(inbox)
-            # NOT daemonic: executors must be able to fork the background
-            # training process and the IPC manager (Spark executors can).
-            p = self._ctx.Process(
-                target=_executor_main,
-                args=(i, d, self._shared_inbox, inbox, self._results),
-                name=f"tfos-executor-{i}",
-                daemon=False,
-            )
-            p.start()
-            self._procs.append(p)
+        saved = {}
+        for k, v in self._env.items():
+            saved[k] = os.environ.get(k)
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = str(v)
+        try:
+            for i in range(self.num_executors):
+                d = os.path.join(self._root, f"executor-{i}")
+                os.makedirs(d, exist_ok=True)
+                self.executor_dirs.append(d)
+                inbox = self._ctx.Queue()
+                self._own_inboxes.append(inbox)
+                # NOT daemonic: executors must be able to fork the background
+                # training process and the IPC manager (Spark executors can).
+                p = self._ctx.Process(
+                    target=_executor_main,
+                    args=(i, d, self._shared_inbox, inbox, self._results),
+                    name=f"tfos-executor-{i}",
+                    daemon=False,
+                )
+                p.start()
+                self._procs.append(p)
+        finally:
+            for k, old in saved.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old
+        # Concurrent jobs (e.g. the node-launcher thread and a feeder) share
+        # one results pipe; this pump demultiplexes per job so one job's
+        # wait loop can never swallow another's completions.
+        self._pump = threading.Thread(
+            target=self._pump_results, name="tfos-result-pump", daemon=True
+        )
+        self._pump.start()
         atexit.register(self.stop)
         logger.info(
             "LocalEngine started %d executors under %s", self.num_executors, self._root
@@ -212,52 +248,94 @@ class LocalEngine:
         """Abort everything (parity: sc.cancelAllJobs before driver exit)."""
         self._cancelled = True
 
-    def _run_job(self, partitions, fn, collect, spread):
+    def _pump_results(self):
+        """Drain the shared results pipe into per-job local queues."""
+        while not getattr(self, "_stopped", False):
+            try:
+                item = self._results.get(timeout=0.2)
+            except _queue.Empty:
+                continue
+            except (OSError, EOFError, ValueError):
+                break
+            except Exception as e:  # noqa: BLE001 - e.g. result unpickling
+                # A poisoned result must not silently kill the pump (every
+                # job would hang); fail all in-flight jobs instead.
+                logger.exception("result pump error")
+                with self._job_lock:
+                    queues = list(self._job_queues.values())
+                for q in queues:
+                    q.put(("error", None, -1, -1, f"result pump error: {e!r}"))
+                continue
+            with self._job_lock:
+                q = self._job_queues.get(item[1])
+            if q is not None:
+                q.put(item)
+            # results for finished/cancelled jobs are dropped
+
+    def _run_job(self, partitions, fn, collect, spread, placement=None):
         """Dispatch one task per partition; block until all complete."""
         if self._cancelled:
             raise TaskError("engine cancelled")
         with self._job_lock:
             self._job_counter += 1
             job_id = self._job_counter
+            my_results = _queue.Queue()
+            self._job_queues[job_id] = my_results
         # Only executors that die DURING this job abort it; one already lost
         # to an earlier job must not fail work the survivors can finish.
         dead_at_start = {i for i, p in enumerate(self._procs) if not p.is_alive()}
-        ntasks = len(partitions)
-        for task_id, part in enumerate(partitions):
-            blob = cloudpickle.dumps((fn, list(part), collect))
-            msg = ("task", job_id, task_id, blob)
-            if spread:
-                self._own_inboxes[task_id % self.num_executors].put(msg)
-            else:
-                self._shared_inbox.put(msg)
-        results = [None] * ntasks
-        done = 0
-        while done < ntasks:
-            if self._cancelled:
-                raise TaskError("engine cancelled")
-            try:
-                status, jid, tid, _idx, payload = self._results.get(timeout=0.25)
-            except _queue.Empty:
-                dead = [
-                    i
-                    for i, p in enumerate(self._procs)
-                    if i not in dead_at_start and not p.is_alive()
-                ]
-                if dead:
-                    raise TaskError(
-                        f"executor(s) {dead} died with tasks in flight "
-                        f"(job {job_id}, {ntasks - done} pending); driver "
-                        "scripts must guard entry with if __name__ == '__main__' "
-                        "when using the default spawn start method"
-                    )
-                continue
-            if jid != job_id:
-                continue  # stale result from a cancelled/failed earlier job
-            if status == "error":
-                raise TaskError(f"task {tid} failed on executor:\n{payload}")
-            results[tid] = payload
-            done += 1
-        return results
+        try:
+            ntasks = len(partitions)
+            for task_id, part in enumerate(partitions):
+                blob = cloudpickle.dumps((fn, list(part), collect))
+                msg = ("task", job_id, task_id, blob)
+                if placement is not None and task_id < len(placement):
+                    target = placement[task_id] % self.num_executors
+                    if not self._procs[target].is_alive():
+                        raise TaskError(
+                            f"cannot place task {task_id} on executor "
+                            f"{target}: executor process is dead"
+                        )
+                    self._own_inboxes[target].put(msg)
+                elif spread:
+                    target = task_id % self.num_executors
+                    if not self._procs[target].is_alive():
+                        raise TaskError(
+                            f"cannot spread task {task_id} to executor "
+                            f"{target}: executor process is dead"
+                        )
+                    self._own_inboxes[target].put(msg)
+                else:
+                    self._shared_inbox.put(msg)
+            results = [None] * ntasks
+            done = 0
+            while done < ntasks:
+                if self._cancelled:
+                    raise TaskError("engine cancelled")
+                try:
+                    status, _jid, tid, _idx, payload = my_results.get(timeout=0.25)
+                except _queue.Empty:
+                    dead = [
+                        i
+                        for i, p in enumerate(self._procs)
+                        if i not in dead_at_start and not p.is_alive()
+                    ]
+                    if dead:
+                        raise TaskError(
+                            f"executor(s) {dead} died with tasks in flight "
+                            f"(job {job_id}, {ntasks - done} pending); driver "
+                            "scripts must guard entry with if __name__ == '__main__' "
+                            "when using the default spawn start method"
+                        )
+                    continue
+                if status == "error":
+                    raise TaskError(f"task {tid} failed on executor:\n{payload}")
+                results[tid] = payload
+                done += 1
+            return results
+        finally:
+            with self._job_lock:
+                self._job_queues.pop(job_id, None)
 
     def stop(self):
         if getattr(self, "_stopped", False):
@@ -294,7 +372,7 @@ class SparkDataset:
     def map_partitions(self, fn):
         return SparkDataset(self.rdd.mapPartitions(fn))
 
-    def foreach_partition(self, fn, spread=False):
+    def foreach_partition(self, fn, spread=False, placement=None):
         self.rdd.foreachPartition(fn)
 
     def collect(self):

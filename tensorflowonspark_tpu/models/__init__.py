@@ -1,0 +1,5 @@
+"""Model zoo: the workloads the reference ships as examples (SURVEY.md §2.5)
+re-built as pure-JAX functional models — MNIST CNN, ResNet (CIFAR +
+ImageNet variants), and encoder-decoder segmentation."""
+
+from tensorflowonspark_tpu.models import layers  # noqa: F401

@@ -1,0 +1,153 @@
+"""Minimal functional layer library: pytree params + pure apply functions.
+
+Design: every layer is an ``init(key, ...) -> params`` plus a pure
+``apply(params, x, ...)``; models are compositions.  No module classes,
+no tracing magic — everything is jit/grad/shard_map friendly, params are
+plain nested dicts that shard naturally with NamedSharding trees.
+
+Convolutions use NHWC with HWIO kernels — the layout XLA:TPU maps best
+onto the MXU; matmuls accumulate in float32 while activations/weights
+may be bfloat16 (``compute_dtype``).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _he_init(key, shape, fan_in, dtype):
+    return jax.random.normal(key, shape, dtype) * jnp.asarray(
+        math.sqrt(2.0 / fan_in), dtype
+    )
+
+
+# -- dense -------------------------------------------------------------------
+
+def dense_init(key, in_dim, out_dim, dtype=jnp.float32):
+    wkey, _ = jax.random.split(key)
+    return {
+        "w": _he_init(wkey, (in_dim, out_dim), in_dim, dtype),
+        "b": jnp.zeros((out_dim,), dtype),
+    }
+
+
+def dense(params, x, precision=None):
+    return (
+        jnp.dot(x, params["w"], precision=precision,
+                preferred_element_type=jnp.float32).astype(x.dtype)
+        + params["b"]
+    )
+
+
+# -- conv --------------------------------------------------------------------
+
+def conv_init(key, h, w, in_ch, out_ch, dtype=jnp.float32, use_bias=True):
+    wkey, _ = jax.random.split(key)
+    p = {"w": _he_init(wkey, (h, w, in_ch, out_ch), h * w * in_ch, dtype)}
+    if use_bias:
+        p["b"] = jnp.zeros((out_ch,), dtype)
+    return p
+
+
+def conv(params, x, stride=1, padding="SAME"):
+    strides = (stride, stride) if isinstance(stride, int) else stride
+    y = lax.conv_general_dilated(
+        x,
+        params["w"],
+        window_strides=strides,
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    if "b" in params:
+        y = y + params["b"]
+    return y
+
+
+# -- norm --------------------------------------------------------------------
+
+def batchnorm_init(ch, dtype=jnp.float32):
+    return {
+        "scale": jnp.ones((ch,), dtype),
+        "bias": jnp.zeros((ch,), dtype),
+        "mean": jnp.zeros((ch,), jnp.float32),
+        "var": jnp.ones((ch,), jnp.float32),
+    }
+
+
+def batchnorm(params, x, train=True, momentum=0.9, eps=1e-5, axis_name=None):
+    """BatchNorm over N,H,W.  In SPMD training under jit, batch statistics
+    are computed over the *global* batch automatically when the batch dim
+    is mesh-sharded (XLA turns the mean reductions into all-reduces); no
+    explicit axis_name is required inside pjit-style code.
+
+    Returns (y, new_params) in train mode; (y, params) in eval mode.
+    """
+    reduce_axes = tuple(range(x.ndim - 1))
+    if train:
+        mean = jnp.mean(x.astype(jnp.float32), axis=reduce_axes)
+        var = jnp.var(x.astype(jnp.float32), axis=reduce_axes)
+        if axis_name is not None:
+            mean = lax.pmean(mean, axis_name)
+            var = lax.pmean(var, axis_name)
+        new = dict(params)
+        new["mean"] = momentum * params["mean"] + (1 - momentum) * mean
+        new["var"] = momentum * params["var"] + (1 - momentum) * var
+    else:
+        mean, var = params["mean"], params["var"]
+        new = params
+    inv = lax.rsqrt(var + eps)
+    y = (x - mean.astype(x.dtype)) * (inv.astype(x.dtype))
+    y = y * params["scale"] + params["bias"]
+    return y, new
+
+
+def layernorm_init(dim, dtype=jnp.float32):
+    return {"scale": jnp.ones((dim,), dtype), "bias": jnp.zeros((dim,), dtype)}
+
+
+def layernorm(params, x, eps=1e-6):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    y = (x - mu) * lax.rsqrt(var + eps)
+    return y * params["scale"] + params["bias"]
+
+
+# -- pooling / activations ---------------------------------------------------
+
+def max_pool(x, window=2, stride=2):
+    return lax.reduce_window(
+        x,
+        -jnp.inf,
+        lax.max,
+        (1, window, window, 1),
+        (1, stride, stride, 1),
+        "VALID",
+    )
+
+
+def avg_pool_global(x):
+    return jnp.mean(x, axis=(1, 2))
+
+
+def relu(x):
+    return jnp.maximum(x, 0)
+
+
+# -- losses ------------------------------------------------------------------
+
+def softmax_cross_entropy(logits, labels, num_classes=None):
+    """Mean CE; integer labels.  Stable log-softmax in float32."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+    logp = logits - logz
+    nll = -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), axis=-1)
+    return jnp.mean(nll)
+
+
+def accuracy(logits, labels):
+    return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))

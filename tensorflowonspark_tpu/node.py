@@ -1,0 +1,465 @@
+"""Executor-side node runtime (parity: reference TFSparkNode.py).
+
+One framework node per engine executor.  The node task:
+
+1. claims TPU chips for this process (tpu_info, parity: _get_gpus),
+2. derives its job/task from the cluster template,
+3. starts the per-executor IPC manager (manager.py),
+4. registers with the driver's rendezvous server and awaits the full
+   cluster (rendezvous.py),
+5. exports the JAX-distributed bootstrap env (coordinator address +
+   process id — the TF_CONFIG equivalent, TFSparkNode.py:366-374),
+6. runs the user ``main_fun(args, ctx)`` — foreground for direct-read
+   workers, background process for InputMode.SPARK workers so the executor
+   slot frees up for feeder tasks, control-queue wait loop for
+   ps/evaluator (TFSparkNode.py:411-443).
+
+The feeder/inference/shutdown closures at the bottom reattach to the
+node's manager through the executor-id file (util.py:77-94 pattern) and
+move data in **chunks** (lists of records), not per-record.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import multiprocessing
+import os
+import socket
+import sys
+import threading
+import time
+import traceback
+
+from tensorflowonspark_tpu import manager as tfmanager
+from tensorflowonspark_tpu import marker, rendezvous, tpu_info
+from tensorflowonspark_tpu.utils import (
+    get_ip_address,
+    read_executor_id,
+    write_executor_id,
+)
+
+logger = logging.getLogger(__name__)
+
+# Records per queue chunk on the feed path; one IPC hop per chunk.
+FEED_CHUNK_RECORDS = int(os.environ.get("TFOS_FEED_CHUNK", "1024"))
+
+COMPUTE_JOBS = ("chief", "master", "worker")
+
+
+class _NodeState:
+    """Per-executor-process globals (parity: TFSparkNode class attrs)."""
+
+    mgr = None
+    cluster_id = None
+
+
+def _get_cluster_spec(cluster_info):
+    """{job: [node_meta sorted by task_index]} (TFSparkNode.py:43-56)."""
+    spec = {}
+    for meta in sorted(cluster_info, key=lambda m: m["executor_id"]):
+        spec.setdefault(meta["job_name"], []).append(meta)
+    for job, nodes in spec.items():
+        seen = {}
+        for n in nodes:
+            if n["task_index"] in seen:
+                raise RuntimeError(
+                    f"duplicate task_index {n['task_index']} in job {job}: "
+                    f"{n} vs {seen[n['task_index']]}"
+                )
+            seen[n["task_index"]] = n
+    return spec
+
+
+def _distributed_env(cluster_info):
+    """Bootstrap info for jax.distributed (the TF_CONFIG replacement).
+
+    Compute processes (chief/master/worker) get contiguous process ids
+    with the chief first; the coordinator is process 0's reserved
+    host:port.  ps/evaluator nodes are *not* part of the SPMD job.
+    """
+    compute = [m for m in cluster_info if m["job_name"] in COMPUTE_JOBS]
+    compute.sort(key=lambda m: (m["job_name"] not in ("chief", "master"), m["executor_id"]))
+    ids = {m["executor_id"]: i for i, m in enumerate(compute)}
+    coordinator = f"{compute[0]['host']}:{compute[0]['port']}" if compute else None
+    return {
+        "coordinator_address": coordinator,
+        "num_processes": len(compute),
+        "process_ids": ids,
+    }
+
+
+class TFNodeContext:
+    """Node metadata handed to user code (parity: TFSparkNode.py:59-99)."""
+
+    def __init__(
+        self,
+        executor_id,
+        job_name,
+        task_index,
+        cluster_spec,
+        default_fs,
+        working_dir,
+        mgr,
+        cluster_info=None,
+    ):
+        self.executor_id = executor_id
+        self.job_name = job_name
+        self.task_index = task_index
+        self.cluster_spec = cluster_spec
+        self.default_fs = default_fs
+        self.working_dir = working_dir
+        self.mgr = mgr
+        self.cluster_info = cluster_info or []
+
+    @property
+    def num_workers(self):
+        return sum(len(v) for k, v in self.cluster_spec.items() if k in COMPUTE_JOBS)
+
+    def absolute_path(self, path):
+        from tensorflowonspark_tpu import feed
+
+        return feed.hdfs_path(self, path)
+
+    def get_data_feed(
+        self, train_mode=True, qname_in="input", qname_out="output", input_mapping=None
+    ):
+        from tensorflowonspark_tpu.feed import DataFeed
+
+        return DataFeed(self.mgr, train_mode, qname_in, qname_out, input_mapping)
+
+    def distributed_env(self):
+        env = _distributed_env(self.cluster_info)
+        return {
+            "coordinator_address": env["coordinator_address"],
+            "num_processes": env["num_processes"],
+            "process_id": env["process_ids"].get(self.executor_id),
+        }
+
+    def jax_initialize(self):
+        """Join the multi-controller JAX job (TF_CONFIG/MWMS replacement).
+
+        No-op for single-process clusters and for ps/evaluator roles.
+        """
+        env = self.distributed_env()
+        if env["num_processes"] <= 1 or env["process_id"] is None:
+            return env
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=env["coordinator_address"],
+            num_processes=env["num_processes"],
+            process_id=env["process_id"],
+        )
+        return env
+
+    def export_env(self):
+        """Export bootstrap env vars for subprocesses (TF_CONFIG parity)."""
+        env = self.distributed_env()
+        os.environ["TFOS_COORDINATOR"] = env["coordinator_address"] or ""
+        os.environ["TFOS_NUM_PROCESSES"] = str(env["num_processes"])
+        os.environ["TFOS_PROCESS_ID"] = str(
+            env["process_id"] if env["process_id"] is not None else -1
+        )
+        os.environ["TFOS_CLUSTER_SPEC"] = json.dumps(
+            {k: [f"{m['host']}:{m['port']}" for m in v] for k, v in self.cluster_spec.items()}
+        )
+
+
+def _job_for_executor(cluster_template, executor_id):
+    for job, ids in cluster_template.items():
+        if executor_id in ids:
+            return job, sorted(ids).index(executor_id)
+    raise RuntimeError(f"executor {executor_id} not in template {cluster_template}")
+
+
+def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
+        queues=None, background=False, num_chips=0):
+    """Build the node-startup closure (parity: TFSparkNode.run :149-445)."""
+    queues = queues or ["input", "output", "error", "control"]
+
+    def _mapfn(iterator):
+        executor_id = None
+        for item in iterator:  # one element per spread partition
+            executor_id = item
+        assert executor_id is not None, "empty node partition"
+
+        # (1) claim TPU chips before any jax/XLA initialization
+        if num_chips > 0:
+            tpu_info.set_visible_chips(num_chips, _same_host_index(executor_id))
+
+        # (2) role from template
+        job_name, task_index = _job_for_executor(
+            cluster_meta["cluster_template"], executor_id
+        )
+
+        # (3) idempotency/retry guard (TFSparkNode.py:249-255): a live
+        # manager from the SAME cluster means a duplicate placement — raise
+        # so the engine/Spark retries this task elsewhere.
+        if (
+            _NodeState.mgr is not None
+            and _NodeState.cluster_id == cluster_meta["id"]
+            and str(_NodeState.mgr.get("state")) in ("running", "terminating")
+        ):
+            raise RuntimeError(
+                f"executor already hosts a node of cluster {cluster_meta['id']}"
+            )
+
+        authkey = bytes.fromhex(cluster_meta["authkey"])
+        mode = "remote" if job_name in ("ps", "evaluator") else "local"
+        mgr = tfmanager.start(authkey, queues, mode)
+        _NodeState.mgr = mgr
+        _NodeState.cluster_id = cluster_meta["id"]
+        write_executor_id(executor_id)
+
+        # (4) rendezvous: reserve a port for the coordinator service (the
+        # free-port trick, TFSparkNode.py:337-342), then register.
+        client = rendezvous.Client(cluster_meta["server_addr"])
+        host = get_ip_address()
+        tmp_sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        tmp_sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        port_env = os.environ.get("TFOS_NODE_PORT")
+        tmp_sock.bind(("", int(port_env) if port_env else 0))
+        port = tmp_sock.getsockname()[1]
+        maddr = list(mgr.address)
+        if mode == "remote" and maddr[0] in ("", "0.0.0.0"):
+            maddr[0] = host  # advertise a dialable address to the driver
+        node_meta = {
+            "executor_id": executor_id,
+            "host": host,
+            "job_name": job_name,
+            "task_index": task_index,
+            "port": port,
+            "addr": maddr,
+            "authkey": cluster_meta["authkey"],
+        }
+        client.register(node_meta)
+        cluster_info = client.await_reservations(
+            timeout=cluster_meta.get("reservation_timeout", 600)
+        )
+        client.close()
+        logger.info("node %d: cluster complete (%d nodes)", executor_id, len(cluster_info))
+
+        # (5) context + bootstrap env
+        cluster_spec = _get_cluster_spec(cluster_info)
+        ctx = TFNodeContext(
+            executor_id,
+            job_name,
+            task_index,
+            cluster_spec,
+            cluster_meta["default_fs"],
+            cluster_meta["working_dir"],
+            mgr,
+            cluster_info,
+        )
+        ctx.export_env()
+
+        # release the reserved port as late as possible
+        tmp_sock.close()
+
+        def wrapper_fn(args, context):
+            if isinstance(args, list):
+                sys.argv = args
+            fn(args, context)
+
+        def wrapper_fn_background(args, context):
+            errq = mgr.get_queue("error")
+            try:
+                wrapper_fn(args, context)
+            except Exception:  # noqa: BLE001 - forwarded via error queue
+                errq.put(traceback.format_exc())
+
+        # (6) execute (TFSparkNode.py:411-443)
+        if job_name in ("ps", "evaluator") or background:
+            logger.info(
+                "starting %s:%d on executor %d in background process",
+                job_name, task_index, executor_id,
+            )
+            fork = multiprocessing.get_context("fork")
+            p = fork.Process(target=wrapper_fn_background, args=(tf_args, ctx))
+            p.daemon = job_name in ("ps", "evaluator")
+            p.start()
+            if job_name in ("ps", "evaluator"):
+                _control_wait_loop(mgr, job_name)
+        else:
+            logger.info(
+                "starting %s:%d on executor %d in foreground",
+                job_name, task_index, executor_id,
+            )
+            wrapper_fn(tf_args, ctx)
+            logger.info("finished %s:%d on executor %d", job_name, task_index, executor_id)
+
+    return _mapfn
+
+
+def _same_host_index(executor_id):
+    """Worker index among same-host peers for chip partitioning."""
+    try:
+        return int(os.environ.get("TFOS_EXECUTOR_INDEX", executor_id))
+    except (TypeError, ValueError):
+        return executor_id
+
+
+def _control_wait_loop(mgr, job_name):
+    """Block a ps/evaluator slot until the driver sends None
+    (TFSparkNode.py:420-438)."""
+    queue = mgr.get_queue("control")
+    equeue = mgr.get_queue("error")
+    while True:
+        while queue.empty() and equeue.empty():
+            time.sleep(1)
+        if not equeue.empty():
+            e_str = equeue.get()
+            equeue.task_done()
+            raise RuntimeError(f"exception in {job_name}:\n{e_str}")
+        msg = queue.get(block=True)
+        queue.task_done()
+        logger.info("%s got control msg: %s", job_name, msg)
+        if msg is None:
+            logger.info("terminating %s", job_name)
+            mgr.set("state", "stopped")
+            return
+
+
+def _get_manager(cluster_info, host, executor_id):
+    """Reattach to this executor's manager (TFSparkNode.py:119-146)."""
+    for meta in cluster_info:
+        if meta["executor_id"] == executor_id:
+            addr = tuple(meta["addr"])
+            authkey = bytes.fromhex(meta["authkey"])
+            return tfmanager.connect(addr, authkey)
+    raise RuntimeError(
+        f"no node of this cluster on executor {executor_id} (host {host}); "
+        f"cluster_info={[(m['host'], m['executor_id']) for m in cluster_info]}"
+    )
+
+
+def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
+    """Feeder closure: push partition records as chunks
+    (parity: TFSparkNode.train :448-515)."""
+
+    def _train(iterator):
+        mgr = _get_manager(cluster_info, get_ip_address(), read_executor_id())
+        queue = mgr.get_queue(qname)
+        state = str(mgr.get("state"))
+        if state in ("terminating", "stopped"):
+            logger.info("feeder: state=%s, skipping/draining partition", state)
+            count = sum(1 for _ in iterator)
+            logger.info("feeder: discarded %d records", count)
+            return
+        total = 0
+        chunk = []
+        for item in iterator:
+            chunk.append(item)
+            if len(chunk) >= FEED_CHUNK_RECORDS:
+                queue.put(chunk, block=True)
+                total += len(chunk)
+                chunk = []
+        if chunk:
+            queue.put(chunk, block=True)
+            total += len(chunk)
+        logger.info("feeder: queued %d records", total)
+
+        # wait for the consumer, polling the error queue (TFSparkNode.py:484-497)
+        joining = threading.Thread(target=queue.join, daemon=True)
+        joining.start()
+        equeue = mgr.get_queue("error")
+        timeout = feed_timeout
+        while joining.is_alive():
+            if not equeue.empty():
+                e_str = equeue.get()
+                equeue.task_done()
+                raise RuntimeError(f"exception in worker:\n{e_str}")
+            time.sleep(1)
+            timeout -= 1
+            if timeout <= 0:
+                raise TimeoutError("timed out waiting for consumption of partition")
+
+        if str(mgr.get("state")) == "terminating":
+            logger.info("feeder: consumer requested termination")
+            client = rendezvous.Client(cluster_meta["server_addr"])
+            client.request_stop()
+
+    return _train
+
+
+def inference(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
+    """Inference closure: feed a partition, collect exactly as many results
+    (parity: TFSparkNode.inference :518-579)."""
+
+    def _inference(iterator):
+        mgr = _get_manager(cluster_info, get_ip_address(), read_executor_id())
+        queue = mgr.get_queue(qname)
+        count = 0
+        chunk = []
+        for item in iterator:
+            chunk.append(item)
+            if len(chunk) >= FEED_CHUNK_RECORDS:
+                queue.put(chunk, block=True)
+                count += len(chunk)
+                chunk = []
+        if chunk:
+            queue.put(chunk, block=True)
+            count += len(chunk)
+        queue.put(marker.EndPartition(), block=True)
+        if count == 0:
+            return []
+
+        # await consumption with error polling
+        joining = threading.Thread(target=queue.join, daemon=True)
+        joining.start()
+        equeue = mgr.get_queue("error")
+        timeout = feed_timeout
+        while joining.is_alive():
+            if not equeue.empty():
+                e_str = equeue.get()
+                equeue.task_done()
+                raise RuntimeError(f"exception in worker:\n{e_str}")
+            time.sleep(0.2)
+            timeout -= 0.2
+            if timeout <= 0:
+                raise TimeoutError("timed out waiting for inference of partition")
+
+        # collect exactly `count` results (results arrive as chunks)
+        results = []
+        out_q = mgr.get_queue("output")
+        while len(results) < count:
+            got = out_q.get(block=True)
+            out_q.task_done()
+            if isinstance(got, list):
+                results.extend(got)
+            else:
+                results.append(got)
+        logger.info("inference: partition yielded %d results", len(results))
+        return results
+
+    return _inference
+
+
+def shutdown(cluster_info, queues, cluster_id, grace_secs=0):
+    """Worker-shutdown closure (parity: TFSparkNode.shutdown :582-636)."""
+
+    def _shutdown(iterator):
+        list(iterator)
+        executor_id = read_executor_id()
+        mgr = _get_manager(cluster_info, get_ip_address(), executor_id)
+        logger.info("shutdown: signalling end-of-feed on executor %s", executor_id)
+        for qname in queues:
+            if qname in ("error", "control"):
+                continue  # end-of-feed applies to data queues only
+            try:
+                mgr.get_queue(qname).put(None, block=True)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("shutdown: queue %s: %s", qname, e)
+        if grace_secs:
+            time.sleep(grace_secs)
+        # PEEK the error queue — get and put back — so an engine/Spark task
+        # retry still observes the failure (TFSparkNode.py:624-630).
+        equeue = mgr.get_queue("error")
+        if not equeue.empty():
+            e_str = equeue.get()
+            equeue.put(e_str)
+            raise RuntimeError(f"exception in worker:\n{e_str}")
+        mgr.set("state", "stopped")
+
+    return _shutdown

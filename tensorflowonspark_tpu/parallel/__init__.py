@@ -1,0 +1,16 @@
+"""Parallelism library: meshes, shardings, and parallel layers.
+
+This is where the reference's delegated parallelism (SURVEY.md §2.3 —
+MultiWorkerMirroredStrategy all-reduce, ParameterServerStrategy, model
+parallelism "insofar as users place ops") becomes first-class TPU-native
+capability: one ``jax.sharding.Mesh`` with named axes, GSPMD shardings,
+and XLA collectives over ICI/DCN.
+"""
+
+from tensorflowonspark_tpu.parallel.mesh import (  # noqa: F401
+    MeshSpec,
+    local_to_global,
+    make_mesh,
+    replicated,
+    sharded,
+)

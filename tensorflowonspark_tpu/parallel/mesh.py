@@ -1,0 +1,115 @@
+"""Device mesh construction and sharding helpers.
+
+The TPU-native replacement for the reference's communication planes
+(SURVEY.md §2.4): instead of a gRPC/NCCL ring configured through
+TF_CONFIG, compute processes join one SPMD job and lay tensors out over a
+named-axis ``Mesh``; XLA inserts the collectives (all-reduce /
+all-gather / reduce-scatter / ppermute) over ICI within a slice and DCN
+across slices.
+
+Axis convention (any subset may be size 1):
+  ``data``  — data parallel (batch sharding)
+  ``fsdp``  — parameter sharding over the data axis group (ZeRO-style)
+  ``model`` — tensor/model parallel
+  ``seq``   — sequence/context parallel (ring attention)
+  ``pipe``  — pipeline stages
+  ``expert``— MoE expert parallel
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from dataclasses import dataclass, field
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+AXIS_ORDER = ("pipe", "data", "fsdp", "seq", "expert", "model")
+
+
+@dataclass
+class MeshSpec:
+    """Named axis sizes; -1 at most once to absorb remaining devices."""
+
+    axes: dict = field(default_factory=dict)
+
+    def resolve(self, n_devices):
+        sizes = dict(self.axes)
+        unknown = [k for k, v in sizes.items() if v == -1]
+        known = math.prod(v for v in sizes.values() if v != -1)
+        if len(unknown) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        if unknown:
+            if n_devices % known:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {sizes}"
+                )
+            sizes[unknown[0]] = n_devices // known
+        if math.prod(sizes.values()) != n_devices:
+            raise ValueError(f"mesh {sizes} != {n_devices} devices")
+        return sizes
+
+
+def make_mesh(axes=None, devices=None, backend=None):
+    """Build a ``jax.sharding.Mesh`` with named axes.
+
+    Args:
+      axes: {name: size} with at most one -1; default {'data': -1}.
+      devices: explicit device list (tests pass ``jax.devices('cpu')``);
+        default: all global devices of ``backend``.
+
+    Device order follows ``jax.devices()``, which orders TPU chips so
+    that neighboring mesh coordinates are ICI neighbors; the trailing
+    mesh axes change fastest, so put the highest-bandwidth axis
+    (``model``) last — AXIS_ORDER does this.
+    """
+    import jax
+
+    if devices is None:
+        devices = jax.devices(backend) if backend else jax.devices()
+    spec = MeshSpec(dict(axes) if axes else {"data": -1})
+    sizes = spec.resolve(len(devices))
+    names = [a for a in AXIS_ORDER if a in sizes] + [
+        a for a in sizes if a not in AXIS_ORDER
+    ]
+    shape = [sizes[n] for n in names]
+    arr = np.asarray(devices).reshape(shape)
+    mesh = jax.sharding.Mesh(arr, tuple(names))
+    logger.info("mesh: %s", dict(zip(names, shape)))
+    return mesh
+
+
+def sharded(mesh, *spec):
+    """NamedSharding over the given PartitionSpec entries."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def replicated(mesh):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def local_to_global(mesh, local_arrays, axis="data"):
+    """Assemble per-process local batches into one global sharded array.
+
+    Multi-controller equivalent of feeding a per-worker shard into a
+    MultiWorkerMirroredStrategy step: each process contributes its local
+    slice of the batch dimension; the result is one global jax.Array laid
+    out over ``axis``.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sh = NamedSharding(mesh, PartitionSpec(axis))
+
+    def place(x):
+        return jax.make_array_from_process_local_data(sh, x)
+
+    return jax.tree_util.tree_map(place, local_arrays)

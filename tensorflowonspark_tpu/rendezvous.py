@@ -115,7 +115,12 @@ class Server(MessageSocket):
 
     def __init__(self, count):
         self.reservations = Reservations(count)
+        # ``done`` is the application-level STOP signal (streaming feeds
+        # watch it); the server keeps *serving* until stop() so late
+        # QUERY/QINFO polls from still-registering nodes never hit a dead
+        # socket.
         self.done = threading.Event()
+        self._closing = threading.Event()
         self._listener = None
         self._thread = None
 
@@ -148,7 +153,7 @@ class Server(MessageSocket):
 
     def _serve(self):
         conns = [self._listener]
-        while not self.done.is_set():
+        while not self._closing.is_set():
             try:
                 readable, _, _ = select.select(conns, [], [], 0.25)
             except OSError:
@@ -217,6 +222,7 @@ class Server(MessageSocket):
 
     def stop(self):
         self.done.set()
+        self._closing.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
 

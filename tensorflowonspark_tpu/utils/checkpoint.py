@@ -1,0 +1,125 @@
+"""Checkpoint / export utilities.
+
+Parity intent: the reference delegates checkpointing to TF and contributes
+the *contract* — model_dir plumbing, chief-only SavedModel export with
+non-chief no-op (reference compat.py:10-17), grace-period export after
+feeding stops.  Here:
+
+- ``save_checkpoint``/``load_checkpoint``: a dependency-free npz format
+  for plain pytrees (always available, used by CI tests);
+- ``export_model``: the chief-only export gate;
+- ``async_checkpointer``: orbax-backed async checkpointing for real runs
+  (GCS-capable), import-gated.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def _flatten(tree, prefix=""):
+    out = {}
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            out.update(_flatten(tree[k], f"{prefix}{k}/"))
+    else:
+        out[prefix[:-1]] = np.asarray(tree)
+    return out
+
+
+def _unflatten(flat):
+    tree = {}
+    for key, value in flat.items():
+        parts = key.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return tree
+
+
+def save_checkpoint(ckpt_dir, params, step, keep=3):
+    """Write step-stamped npz checkpoint; prune old ones."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    flat = _flatten(_to_host(params))
+    path = os.path.join(ckpt_dir, f"ckpt-{step:08d}.npz")
+    # pid-unique tmp: concurrent writers (several workers sharing one
+    # filesystem) must not clobber each other's in-flight file
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **flat)
+    os.replace(tmp, path)  # atomic publish
+    logger.info("saved checkpoint %s", path)
+    ckpts = sorted(p for p in os.listdir(ckpt_dir) if p.startswith("ckpt-"))
+    for old in ckpts[:-keep]:
+        os.remove(os.path.join(ckpt_dir, old))
+    return path
+
+
+def latest_checkpoint(ckpt_dir):
+    if not os.path.isdir(ckpt_dir):
+        return None
+    ckpts = sorted(p for p in os.listdir(ckpt_dir) if p.startswith("ckpt-"))
+    return os.path.join(ckpt_dir, ckpts[-1]) if ckpts else None
+
+
+def load_checkpoint(path):
+    with np.load(path) as z:
+        return _unflatten({k: z[k] for k in z.files})
+
+
+def export_model(export_dir, params, ctx=None, metadata=None):
+    """Chief-only model export (parity: reference compat.py:10-17 —
+    non-chief workers write nothing instead of a dummy dir)."""
+    if ctx is not None and not is_chief(ctx):
+        logger.info("export_model: not chief (%s:%s), skipping",
+                    ctx.job_name, ctx.task_index)
+        return None
+    os.makedirs(export_dir, exist_ok=True)
+    flat = _flatten(_to_host(params))
+    with open(os.path.join(export_dir, "params.npz"), "wb") as f:
+        np.savez(f, **flat)
+    meta = {"format": "tfos-tpu-export-v1"}
+    meta.update(metadata or {})
+    with open(os.path.join(export_dir, "export.json"), "w") as f:
+        json.dump(meta, f)
+    logger.info("exported model to %s", export_dir)
+    return export_dir
+
+
+def load_exported(export_dir):
+    with np.load(os.path.join(export_dir, "params.npz")) as z:
+        params = _unflatten({k: z[k] for k in z.files})
+    with open(os.path.join(export_dir, "export.json")) as f:
+        meta = json.load(f)
+    return params, meta
+
+
+def is_chief(ctx):
+    """process 0 duties: chief/master role, else worker:0
+    (reference ctx.job_name=='chief' convention)."""
+    if ctx.job_name in ("chief", "master"):
+        return True
+    has_chief = any(j in ctx.cluster_spec for j in ("chief", "master"))
+    return not has_chief and ctx.job_name == "worker" and ctx.task_index == 0
+
+
+def _to_host(params):
+    import jax
+
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), params)
+
+
+def async_checkpointer(ckpt_dir):
+    """Orbax async checkpointer for production runs (GCS paths work)."""
+    import orbax.checkpoint as ocp
+
+    return ocp.CheckpointManager(
+        ckpt_dir, options=ocp.CheckpointManagerOptions(max_to_keep=3)
+    )

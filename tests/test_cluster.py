@@ -1,0 +1,118 @@
+"""End-to-end cluster tests (parity: reference test/test_TFCluster.py).
+
+Runs real multi-process clusters on the LocalEngine: independent node
+programs, the InputMode.SPARK inference round-trip (squares of 0..999,
+sum == 332,833,500 — the reference's functional baseline), and the two
+fault-injection scenarios (failure during and after feeding).
+"""
+
+import os
+
+import pytest
+
+from tensorflowonspark_tpu import cluster as TFCluster
+from tensorflowonspark_tpu.cluster import InputMode
+from tensorflowonspark_tpu.engine import LocalEngine, TaskError
+
+
+@pytest.fixture()
+def engine():
+    e = LocalEngine(2)
+    yield e
+    e.stop()
+
+
+# --- node programs (module-level: shipped to executor processes) -----------
+
+def _independent_fn(args, ctx):
+    # each node computes on its own, no cluster comm (test_TFCluster.py:16-27)
+    with open("result", "w") as f:
+        f.write(str(sum(x * x for x in range(10))))
+
+
+def _squares_fn(args, ctx):
+    feed = ctx.get_data_feed(train_mode=False)
+    while not feed.should_stop():
+        batch = feed.next_batch(100)
+        if batch:
+            feed.batch_results([x * x for x in batch])
+
+
+def _fail_during_feed_fn(args, ctx):
+    raise RuntimeError("deliberate failure during feeding")
+
+
+def _fail_after_feed_fn(args, ctx):
+    feed = ctx.get_data_feed(train_mode=True)
+    while not feed.should_stop():
+        feed.next_batch(100)
+    raise RuntimeError("deliberate failure after feeding")
+
+
+def _terminate_fn(args, ctx):
+    feed = ctx.get_data_feed(train_mode=True)
+    feed.next_batch(10)
+    feed.terminate()
+
+
+# --- tests ------------------------------------------------------------------
+
+def test_independent_nodes(engine):
+    cluster = TFCluster.run(
+        engine, _independent_fn, [], num_executors=2,
+        input_mode=InputMode.TENSORFLOW,
+    )
+    cluster.shutdown()
+    found = (
+        engine.parallelize(range(2), 2)
+        .map_partitions(lambda it: [open("result").read()])
+        .collect()
+    )
+    assert found == ["285", "285"]
+
+
+def test_inference_roundtrip(engine):
+    cluster = TFCluster.run(
+        engine, _squares_fn, [], num_executors=2, input_mode=InputMode.SPARK,
+    )
+    ds = engine.parallelize(range(1000), 4)
+    results = cluster.inference(ds).collect()
+    cluster.shutdown()
+    assert len(results) == 1000
+    assert sum(results) == 332833500  # reference baseline test_TFCluster.py:44-47
+
+
+def test_failure_during_feeding(engine):
+    cluster = TFCluster.run(
+        engine, _fail_during_feed_fn, [], num_executors=2,
+        input_mode=InputMode.SPARK,
+    )
+    ds = engine.parallelize(range(1000), 4)
+    with pytest.raises(TaskError):
+        cluster.train(ds, feed_timeout=3)
+    # the feeder consumed & re-raised the error, so shutdown may be clean
+    try:
+        cluster.shutdown()
+    except (TaskError, SystemExit):
+        pass
+
+
+def test_failure_after_feeding(engine):
+    cluster = TFCluster.run(
+        engine, _fail_after_feed_fn, [], num_executors=2,
+        input_mode=InputMode.SPARK,
+    )
+    ds = engine.parallelize(range(100), 2)
+    cluster.train(ds)
+    with pytest.raises((TaskError, SystemExit)):
+        cluster.shutdown(grace_secs=3)
+
+
+def test_datafeed_terminate_requests_stop(engine):
+    cluster = TFCluster.run(
+        engine, _terminate_fn, [], num_executors=2, input_mode=InputMode.SPARK,
+    )
+    ds = engine.parallelize(range(2000), 2)
+    cluster.train(ds)
+    assert cluster.server.done.wait(15)
+    cluster.shutdown()
