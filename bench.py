@@ -1,0 +1,103 @@
+"""Benchmark: ResNet-50 training throughput on the attached accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
+value is model FLOPs utilization (MFU) of the ResNet-50 train step and
+vs_baseline is relative to the BASELINE.json north-star of 0.50 MFU.
+Also reports images/sec/chip inside the same line's "extra" field.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+# known bf16 peak TFLOP/s per chip by device kind substring
+_PEAKS = {
+    "v5 lite": 197e12,  # v5e
+    "v5e": 197e12,
+    "v4": 275e12,
+    "v5p": 459e12,
+    "v6": 918e12,  # trillium
+}
+
+
+def _peak_flops(device):
+    env = os.environ.get("TFOS_PEAK_FLOPS")
+    if env:
+        return float(env)
+    kind = getattr(device, "device_kind", "").lower()
+    for k, v in _PEAKS.items():
+        if k in kind:
+            return v
+    return 197e12  # default: v5e
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tensorflowonspark_tpu.models import resnet
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    batch = int(os.environ.get("TFOS_BENCH_BATCH", "256" if on_tpu else "16"))
+    image = int(os.environ.get("TFOS_BENCH_IMAGE", "224" if on_tpu else "64"))
+    steps = int(os.environ.get("TFOS_BENCH_STEPS", "20" if on_tpu else "3"))
+
+    from jax import lax
+
+    params, state = resnet.init(jax.random.PRNGKey(0), depth=50, num_classes=1000)
+    opt = optax.sgd(0.1, momentum=0.9)
+    opt_state = opt.init(params)
+    step_fn = resnet.make_train_step(opt, depth=50)
+
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.random((batch, image, image, 3), dtype=np.float32),
+                         dtype=jnp.bfloat16)
+    labels = jnp.asarray(rng.integers(0, 1000, batch), dtype=jnp.int32)
+
+    # Chain `steps` train steps inside one jit (lax.scan): one dispatch,
+    # one result fetch — honest device time, no per-step host round-trips
+    # (and immune to async-dispatch timing artifacts).
+    @jax.jit
+    def run_steps(params, state, opt_state, images, labels):
+        def body(carry, _):
+            p, s, o = carry
+            p, s, o, loss, _acc = step_fn(p, s, o, images, labels)
+            return (p, s, o), loss
+
+        (p, s, o), losses = lax.scan(body, (params, state, opt_state),
+                                     None, length=steps)
+        return losses[-1]
+
+    # warmup / compile
+    float(run_steps(params, state, opt_state, images, labels))
+
+    t0 = time.perf_counter()
+    loss = float(run_steps(params, state, opt_state, images, labels))
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = batch * steps / dt
+    # fwd+bwd ≈ 3x forward FLOPs
+    flops_per_img = 3.0 * resnet.flops_per_image(50, image)
+    achieved = imgs_per_sec * flops_per_img
+    mfu = achieved / _peak_flops(dev)
+
+    print(json.dumps({
+        "metric": "resnet50_train_mfu",
+        "value": round(mfu, 4),
+        "unit": "fraction_of_peak",
+        "vs_baseline": round(mfu / 0.50, 4),
+        "extra": {
+            "images_per_sec_per_chip": round(imgs_per_sec, 1),
+            "batch": batch, "image": image, "steps": steps,
+            "device": str(dev), "platform": dev.platform,
+            "loss": loss,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

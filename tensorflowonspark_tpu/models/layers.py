@@ -36,10 +36,11 @@ def dense_init(key, in_dim, out_dim, dtype=jnp.float32):
 
 
 def dense(params, x, precision=None):
+    w = params["w"].astype(x.dtype)  # params live in fp32; compute dtype follows x
     return (
-        jnp.dot(x, params["w"], precision=precision,
+        jnp.dot(x, w, precision=precision,
                 preferred_element_type=jnp.float32).astype(x.dtype)
-        + params["b"]
+        + params["b"].astype(x.dtype)
     )
 
 
@@ -55,55 +56,60 @@ def conv_init(key, h, w, in_ch, out_ch, dtype=jnp.float32, use_bias=True):
 
 def conv(params, x, stride=1, padding="SAME"):
     strides = (stride, stride) if isinstance(stride, int) else stride
+    # No explicit preferred_element_type: the TPU MXU already accumulates
+    # bf16 convs in f32, and an f32 result dtype breaks the conv transpose
+    # (bf16 operands meet an f32 cotangent in the backward pass).
     y = lax.conv_general_dilated(
         x,
-        params["w"],
+        params["w"].astype(x.dtype),  # fp32 master weights, bf16 compute
         window_strides=strides,
         padding=padding,
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        preferred_element_type=jnp.float32,
-    ).astype(x.dtype)
+    )
     if "b" in params:
-        y = y + params["b"]
+        y = y + params["b"].astype(x.dtype)
     return y
 
 
 # -- norm --------------------------------------------------------------------
 
 def batchnorm_init(ch, dtype=jnp.float32):
-    return {
-        "scale": jnp.ones((ch,), dtype),
-        "bias": jnp.zeros((ch,), dtype),
-        "mean": jnp.zeros((ch,), jnp.float32),
-        "var": jnp.ones((ch,), jnp.float32),
-    }
+    """Returns (params, state): trainable scale/bias vs running stats.
+
+    Keeping running statistics in a separate state tree keeps the
+    optimizer and grad transform off them (they receive no gradient)."""
+    params = {"scale": jnp.ones((ch,), dtype), "bias": jnp.zeros((ch,), dtype)}
+    state = {"mean": jnp.zeros((ch,), jnp.float32), "var": jnp.ones((ch,), jnp.float32)}
+    return params, state
 
 
-def batchnorm(params, x, train=True, momentum=0.9, eps=1e-5, axis_name=None):
+def batchnorm(params, state, x, train=True, momentum=0.9, eps=1e-5):
     """BatchNorm over N,H,W.  In SPMD training under jit, batch statistics
     are computed over the *global* batch automatically when the batch dim
-    is mesh-sharded (XLA turns the mean reductions into all-reduces); no
-    explicit axis_name is required inside pjit-style code.
+    is mesh-sharded (XLA turns the mean reductions into all-reduces).
 
-    Returns (y, new_params) in train mode; (y, params) in eval mode.
+    Returns (y, new_state); state is unchanged in eval mode.
     """
     reduce_axes = tuple(range(x.ndim - 1))
     if train:
-        mean = jnp.mean(x.astype(jnp.float32), axis=reduce_axes)
-        var = jnp.var(x.astype(jnp.float32), axis=reduce_axes)
-        if axis_name is not None:
-            mean = lax.pmean(mean, axis_name)
-            var = lax.pmean(var, axis_name)
-        new = dict(params)
-        new["mean"] = momentum * params["mean"] + (1 - momentum) * mean
-        new["var"] = momentum * params["var"] + (1 - momentum) * var
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=reduce_axes)
+        var = jnp.var(xf, axis=reduce_axes)
+        new = {
+            "mean": momentum * state["mean"] + (1 - momentum) * mean,
+            "var": momentum * state["var"] + (1 - momentum) * var,
+        }
     else:
-        mean, var = params["mean"], params["var"]
-        new = params
+        mean, var = state["mean"], state["var"]
+        new = state
     inv = lax.rsqrt(var + eps)
-    y = (x - mean.astype(x.dtype)) * (inv.astype(x.dtype))
-    y = y * params["scale"] + params["bias"]
-    return y, new
+    # fold (mean, inv, scale, bias) in f32, apply as one fused
+    # multiply-add in the compute dtype — keeps activations bf16 (an f32
+    # scale would silently upcast the whole network downstream)
+    mul = (inv * params["scale"].astype(jnp.float32)).astype(x.dtype)
+    add = (params["bias"].astype(jnp.float32) - mean * inv
+           * params["scale"].astype(jnp.float32)).astype(x.dtype)
+    return x * mul + add, new
 
 
 def layernorm_init(dim, dtype=jnp.float32):

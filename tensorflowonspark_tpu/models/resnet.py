@@ -1,0 +1,176 @@
+"""ResNet family (parity workloads: reference examples/resnet — ResNet-56
+CIFAR-10 via resnet_cifar_dist.py — and the ResNet-50/ImageNet north-star
+config from BASELINE.json).
+
+TPU-first choices:
+- NHWC + HWIO everywhere (XLA:TPU's preferred conv layout for MXU tiling);
+- parameters in float32, activations/conv compute in bfloat16 (the TPU
+  MXU accumulates bf16 convolutions in float32 natively);
+- BN running stats in a separate state tree (no optimizer traffic);
+- train step is one jittable function — under a mesh-sharded batch, XLA
+  emits the gradient all-reduce over ICI.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tensorflowonspark_tpu.models import layers as L
+
+# stage plans: depth -> (block, per-stage block counts).
+# ImageNet family: 4 stages, width 64.  CIFAR family (6n+2 layers): 3
+# stages of n basic blocks — use width=16, small_inputs=True, e.g.
+# init(key, depth=56, num_classes=10, width=16, small_inputs=True).
+_PLANS = {
+    18: ("basic", (2, 2, 2, 2)),
+    34: ("basic", (3, 4, 6, 3)),
+    50: ("bottleneck", (3, 4, 6, 3)),
+    101: ("bottleneck", (3, 4, 23, 3)),
+    152: ("bottleneck", (3, 8, 36, 3)),
+    # CIFAR 6n+2 plans (reference resnet_cifar_dist.py workload family)
+    20: ("basic", (3, 3, 3)),
+    32: ("basic", (5, 5, 5)),
+    44: ("basic", (7, 7, 7)),
+    56: ("basic", (9, 9, 9)),
+    110: ("basic", (18, 18, 18)),
+}
+
+
+def _block_init(key, kind, in_ch, ch, stride, dtype):
+    ks = jax.random.split(key, 4)
+    p, s = {}, {}
+    if kind == "bottleneck":
+        out_ch = ch * 4
+        p["conv1"] = L.conv_init(ks[0], 1, 1, in_ch, ch, dtype, use_bias=False)
+        p["bn1"], s["bn1"] = L.batchnorm_init(ch)
+        p["conv2"] = L.conv_init(ks[1], 3, 3, ch, ch, dtype, use_bias=False)
+        p["bn2"], s["bn2"] = L.batchnorm_init(ch)
+        p["conv3"] = L.conv_init(ks[2], 1, 1, ch, out_ch, dtype, use_bias=False)
+        p["bn3"], s["bn3"] = L.batchnorm_init(out_ch)
+    else:
+        out_ch = ch
+        p["conv1"] = L.conv_init(ks[0], 3, 3, in_ch, ch, dtype, use_bias=False)
+        p["bn1"], s["bn1"] = L.batchnorm_init(ch)
+        p["conv2"] = L.conv_init(ks[1], 3, 3, ch, ch, dtype, use_bias=False)
+        p["bn2"], s["bn2"] = L.batchnorm_init(ch)
+    if stride != 1 or in_ch != out_ch:
+        p["proj"] = L.conv_init(ks[3], 1, 1, in_ch, out_ch, dtype, use_bias=False)
+        p["bn_proj"], s["bn_proj"] = L.batchnorm_init(out_ch)
+    return p, s, out_ch
+
+
+def _block_apply(p, s, x, kind, stride, train):
+    ns = {}
+    shortcut = x
+    if "proj" in p:
+        shortcut = L.conv(p["proj"], x, stride=stride)
+        shortcut, ns["bn_proj"] = L.batchnorm(p["bn_proj"], s["bn_proj"], shortcut, train)
+    if kind == "bottleneck":
+        y = L.conv(p["conv1"], x)
+        y, ns["bn1"] = L.batchnorm(p["bn1"], s["bn1"], y, train)
+        y = L.relu(y)
+        y = L.conv(p["conv2"], y, stride=stride)
+        y, ns["bn2"] = L.batchnorm(p["bn2"], s["bn2"], y, train)
+        y = L.relu(y)
+        y = L.conv(p["conv3"], y)
+        y, ns["bn3"] = L.batchnorm(p["bn3"], s["bn3"], y, train)
+    else:
+        y = L.conv(p["conv1"], x, stride=stride)
+        y, ns["bn1"] = L.batchnorm(p["bn1"], s["bn1"], y, train)
+        y = L.relu(y)
+        y = L.conv(p["conv2"], y)
+        y, ns["bn2"] = L.batchnorm(p["bn2"], s["bn2"], y, train)
+    return L.relu(y + shortcut), ns
+
+
+def init(key, depth=50, num_classes=1000, width=64, small_inputs=False,
+         dtype=jnp.float32):
+    """Build (params, state).  ``small_inputs``: CIFAR-style 3x3 stem
+    without max-pool (reference resnet_cifar uses the small stem)."""
+    kind, counts = _PLANS[depth]
+    keys = jax.random.split(key, sum(counts) + 2)
+    ki = iter(keys)
+    params, state = {}, {}
+    if small_inputs:
+        params["stem"] = L.conv_init(next(ki), 3, 3, 3, width, dtype, use_bias=False)
+    else:
+        params["stem"] = L.conv_init(next(ki), 7, 7, 3, width, dtype, use_bias=False)
+    params["bn_stem"], state["bn_stem"] = L.batchnorm_init(width)
+    in_ch = width
+    for stage, nblocks in enumerate(counts):
+        ch = width * (2 ** stage)
+        for b in range(nblocks):
+            stride = 2 if (b == 0 and stage > 0) else 1
+            name = f"s{stage}b{b}"
+            params[name], state[name], in_ch = _block_init(
+                next(ki), kind, in_ch, ch, stride, dtype
+            )
+    params["fc"] = L.dense_init(next(ki), in_ch, num_classes, dtype)
+    return params, state
+
+
+def apply(params, state, images, depth=50, train=True, small_inputs=False,
+          compute_dtype=jnp.bfloat16):
+    """images [N,H,W,3] → logits [N,num_classes]; returns (logits, new_state)."""
+    kind, counts = _PLANS[depth]
+    x = images.astype(compute_dtype)
+    new_state = {}
+    if small_inputs:
+        x = L.conv(params["stem"], x)
+    else:
+        x = L.conv(params["stem"], x, stride=2)
+    x, new_state["bn_stem"] = L.batchnorm(params["bn_stem"], state["bn_stem"], x, train)
+    x = L.relu(x)
+    if not small_inputs:
+        x = L.max_pool(x, window=3, stride=2)
+    for stage, nblocks in enumerate(counts):
+        for b in range(nblocks):
+            stride = 2 if (b == 0 and stage > 0) else 1
+            name = f"s{stage}b{b}"
+            x, new_state[name] = _block_apply(
+                params[name], state[name], x, kind, stride, train
+            )
+    x = L.avg_pool_global(x).astype(jnp.float32)
+    return L.dense(params["fc"], x), new_state
+
+
+def make_train_step(optimizer, depth=50, small_inputs=False,
+                    compute_dtype=jnp.bfloat16, remat=False):
+    """(params, state, opt_state, images, labels) →
+    (params, state, opt_state, loss, acc); jittable, SPMD-ready."""
+
+    fwd = apply
+    if remat:
+        fwd = jax.checkpoint(apply, static_argnums=(3, 4, 5, 6))
+
+    def loss_fn(params, state, images, labels):
+        logits, new_state = fwd(
+            params, state, images, depth, True, small_inputs, compute_dtype
+        )
+        return L.softmax_cross_entropy(logits, labels), (logits, new_state)
+
+    def train_step(params, state, opt_state, images, labels):
+        (loss, (logits, new_state)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params, state, images, labels)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_state, opt_state, loss, L.accuracy(logits, labels)
+
+    return train_step
+
+
+def flops_per_image(depth=50, image_size=224):
+    """Approximate forward-pass FLOPs per image (2*MACs), for MFU math."""
+    if depth in (18, 34, 50, 101, 152):
+        # standard 224x224 figures
+        base = {18: 1.8e9, 34: 3.6e9, 50: 4.09e9, 101: 7.8e9, 152: 11.5e9}[depth]
+        ref = 224
+    else:
+        # CIFAR 6n+2 family at 32x32 (2*MACs)
+        base = {20: 0.082e9, 32: 0.138e9, 44: 0.194e9,
+                56: 0.252e9, 110: 0.51e9}[depth]
+        ref = 32
+    return base * (image_size / ref) ** 2

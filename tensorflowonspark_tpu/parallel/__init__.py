@@ -14,3 +14,10 @@ from tensorflowonspark_tpu.parallel.mesh import (  # noqa: F401
     replicated,
     sharded,
 )
+from tensorflowonspark_tpu.parallel.sharding import (  # noqa: F401
+    apply_shardings,
+    batch_sharding,
+    fsdp_sharding,
+    replicated_sharding,
+    shard_train_state,
+)

@@ -1,0 +1,79 @@
+"""Sharding rules: how parameter/optimizer/batch trees map onto the mesh.
+
+This is the GSPMD replacement for the reference's strategy zoo
+(SURVEY.md §2.3): instead of choosing a tf.distribute strategy, callers
+pick mesh axis sizes and these helpers lay every tensor out; XLA inserts
+the collectives (all-gather for fsdp parameter reassembly,
+reduce-scatter/all-reduce for gradients) over ICI.
+"""
+
+from __future__ import annotations
+
+from tensorflowonspark_tpu.parallel.mesh import replicated as replicated_sharding
+
+
+def batch_sharding(mesh, axes=("data", "fsdp")):
+    """Sharding for [batch, ...] arrays: batch dim split over data axes."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    present = tuple(a for a in axes if a in mesh.shape and mesh.shape[a] > 1)
+    return NamedSharding(mesh, PartitionSpec(present if present else None))
+
+
+def fsdp_sharding(mesh, tree, axis="fsdp", min_shard_elems=2 ** 12):
+    """ZeRO-style parameter sharding: for each leaf, shard the largest
+    dimension divisible by the fsdp axis size; small/indivisible leaves
+    stay replicated.  Applied to params AND optimizer state (optimizer
+    moments follow their parameter's layout).
+
+    Returns a pytree of NamedSharding matching ``tree``.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    n = mesh.shape.get(axis, 1)
+
+    def rule(leaf):
+        shape = getattr(leaf, "shape", ())
+        if n <= 1 or not shape or leaf.size < min_shard_elems:
+            return NamedSharding(mesh, PartitionSpec())
+        # prefer the largest divisible dim (usually the output channels)
+        dims = sorted(range(len(shape)), key=lambda d: -shape[d])
+        for d in dims:
+            if shape[d] % n == 0:
+                spec = [None] * len(shape)
+                spec[d] = axis
+                return NamedSharding(mesh, PartitionSpec(*spec))
+        return NamedSharding(mesh, PartitionSpec())
+
+    return jax.tree_util.tree_map(rule, tree)
+
+
+def apply_shardings(tree, shardings):
+    """Device-put a pytree according to a matching sharding tree (single
+    batched transfer)."""
+    import jax
+
+    return jax.device_put(tree, shardings)
+
+
+def shard_train_state(mesh, params, state, opt_state, fsdp_axis="fsdp"):
+    """Lay out the full train state: fsdp for params & optimizer moments,
+    replicated BN state (tiny), returning (placed tensors, shardings)."""
+    import jax
+
+    p_sh = fsdp_sharding(mesh, params, fsdp_axis)
+    s_sh = jax.tree_util.tree_map(lambda _: replicated_sharding(mesh), state)
+    # optimizer moments mirror their parameter's layout; scalar step
+    # counters replicate
+    o_sh = jax.tree_util.tree_map(
+        lambda leaf: fsdp_sharding(mesh, leaf, fsdp_axis)
+        if getattr(leaf, "ndim", 0) else replicated_sharding(mesh),
+        opt_state,
+    )
+    placed = (
+        apply_shardings(params, p_sh),
+        apply_shardings(state, s_sh),
+        apply_shardings(opt_state, o_sh),
+    )
+    return placed, (p_sh, s_sh, o_sh)
