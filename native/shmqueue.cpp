@@ -1,0 +1,195 @@
+// Shared-memory ring buffer: the zero-copy feed path between the engine's
+// feeder task and the training process on one host.
+//
+// TPU-native replacement for the reference's per-record pickled
+// multiprocessing queues (the documented hot-loop bottleneck,
+// TFSparkNode.py:480-482 ↔ TFNode.py:265-287): a single-producer /
+// single-consumer byte ring in POSIX shared memory carrying *batches*
+// (e.g. serialized record chunks or raw tensor blocks) with no syscalls
+// on the fast path.
+//
+// Layout: Header | data[capacity]
+//   head: next write offset (producer-owned), tail: next read offset
+//   (consumer-owned); both are free-running uint64 counters mod capacity.
+//   Each message: uint32 len | payload | padding to 8 bytes.
+//   closed: producer sets when done (consumer drains then sees EOF).
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <string>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x54464f53514d5631ull;  // "TFOSQMV1"
+
+struct Header {
+  uint64_t magic;
+  uint64_t capacity;
+  std::atomic<uint64_t> head;
+  std::atomic<uint64_t> tail;
+  std::atomic<uint32_t> closed;
+  uint32_t _pad;
+};
+
+struct Queue {
+  Header* h;
+  uint8_t* data;
+  size_t map_len;
+  std::string name;
+  std::vector<uint8_t> scratch;
+  bool owner;
+};
+
+inline uint64_t align8(uint64_t n) { return (n + 7) & ~7ull; }
+
+void sleep_us(unsigned us) {
+  struct timespec ts {0, (long)us * 1000};
+  nanosleep(&ts, nullptr);
+}
+
+}  // namespace
+
+extern "C" {
+
+Queue* shq_create(const char* name, uint64_t capacity) {
+  capacity = align8(capacity);
+  shm_unlink(name);  // stale segment from a crashed run
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  size_t len = sizeof(Header) + capacity;
+  if (ftruncate(fd, (off_t)len) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(name);
+    return nullptr;
+  }
+  auto* h = new (mem) Header();
+  h->capacity = capacity;
+  h->head.store(0);
+  h->tail.store(0);
+  h->closed.store(0);
+  h->magic = kMagic;  // published last
+  auto* q = new Queue{h, (uint8_t*)mem + sizeof(Header), len, name, {}, true};
+  return q;
+}
+
+Queue* shq_open(const char* name, int timeout_ms) {
+  int fd = -1;
+  for (int waited = 0;; waited += 10) {
+    fd = shm_open(name, O_RDWR, 0600);
+    if (fd >= 0) break;
+    if (waited >= timeout_ms) return nullptr;
+    sleep_us(10000);
+  }
+  struct stat st;
+  if (fstat(fd, &st) != 0 || (size_t)st.st_size < sizeof(Header)) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  auto* h = (Header*)mem;
+  for (int waited = 0; h->magic != kMagic; waited += 1) {
+    if (waited > 1000) {
+      munmap(mem, (size_t)st.st_size);
+      return nullptr;
+    }
+    sleep_us(1000);
+  }
+  auto* q = new Queue{h, (uint8_t*)mem + sizeof(Header), (size_t)st.st_size,
+                      name, {}, false};
+  return q;
+}
+
+// 0 ok; -1 timeout; -2 closed; -3 message larger than capacity
+int shq_push(Queue* q, const uint8_t* buf, uint64_t len, int timeout_ms) {
+  Header* h = q->h;
+  uint64_t need = align8(4 + len);
+  if (need + 8 > h->capacity) return -3;
+  int waited_us = 0;
+  for (;;) {
+    if (h->closed.load(std::memory_order_acquire)) return -2;
+    uint64_t head = h->head.load(std::memory_order_relaxed);
+    uint64_t tail = h->tail.load(std::memory_order_acquire);
+    if (head + need - tail <= h->capacity - 8) {
+      uint64_t off = head % h->capacity;
+      uint32_t len32 = (uint32_t)len;
+      // header word never wraps (8-byte alignment); payload may wrap
+      memcpy(q->data + off, &len32, 4);
+      uint64_t poff = (off + 4) % h->capacity;
+      uint64_t first = std::min(len, h->capacity - poff);
+      memcpy(q->data + poff, buf, first);
+      if (first < len) memcpy(q->data, buf + first, len - first);
+      h->head.store(head + need, std::memory_order_release);
+      return 0;
+    }
+    if (timeout_ms >= 0 && waited_us / 1000 >= timeout_ms) return -1;
+    sleep_us(waited_us < 2000 ? 50 : 500);
+    waited_us += waited_us < 2000 ? 50 : 500;
+  }
+}
+
+// >=0: message length (0 = legitimately empty payload) copied into
+// internal scratch (get via shq_buffer); -1: timeout; -2: EOF (closed and
+// drained).
+int64_t shq_pop(Queue* q, int timeout_ms) {
+  Header* h = q->h;
+  int waited_us = 0;
+  for (;;) {
+    uint64_t tail = h->tail.load(std::memory_order_relaxed);
+    uint64_t head = h->head.load(std::memory_order_acquire);
+    if (head != tail) {
+      uint64_t off = tail % h->capacity;
+      uint32_t len32;
+      memcpy(&len32, q->data + off, 4);
+      q->scratch.resize(len32);
+      uint64_t poff = (off + 4) % h->capacity;
+      uint64_t first = std::min((uint64_t)len32, h->capacity - poff);
+      memcpy(q->scratch.data(), q->data + poff, first);
+      if (first < len32)
+        memcpy(q->scratch.data() + first, q->data, len32 - first);
+      h->tail.store(tail + align8(4 + len32), std::memory_order_release);
+      return (int64_t)len32;
+    }
+    if (h->closed.load(std::memory_order_acquire)) return -2;
+    if (timeout_ms >= 0 && waited_us / 1000 >= timeout_ms) return -1;
+    sleep_us(waited_us < 2000 ? 50 : 500);
+    waited_us += waited_us < 2000 ? 50 : 500;
+  }
+}
+
+const uint8_t* shq_buffer(Queue* q) { return q->scratch.data(); }
+
+void shq_close_write(Queue* q) {
+  q->h->closed.store(1, std::memory_order_release);
+}
+
+uint64_t shq_size(Queue* q) {
+  return q->h->head.load() - q->h->tail.load();
+}
+
+void shq_free(Queue* q) {
+  bool owner = q->owner;
+  std::string name = q->name;
+  munmap((void*)((uint8_t*)q->h), q->map_len);
+  if (owner) shm_unlink(name.c_str());
+  delete q;
+}
+
+}  // extern "C"

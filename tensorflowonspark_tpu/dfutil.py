@@ -1,0 +1,172 @@
+"""TFRecord ⇄ row-table interop (parity: reference tensorflowonspark/dfutil.py
++ DFUtil.scala).
+
+The reference converts Spark DataFrames to tf.train.Example TFRecords and
+back, inferring the schema from the first record with a
+``binary_features`` hint to disambiguate bytes vs string
+(dfutil.py:44-81,134-168).  Here rows are plain dicts (the engine's
+datasets carry them; a Spark DataFrame's ``.rdd`` of Rows works
+unchanged), and record IO is the native C++ reader/writer — no
+TensorFlow or Hadoop dependency.
+
+Type mapping (dfutil.py:84-131 / DFUtil.scala:195-258 dtype matrix):
+  int/bool      → int64_list        float         → float_list
+  str           → bytes_list(utf8)  bytes         → bytes_list
+  list[...]     → the element kind's list (marked array in the schema)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from tensorflowonspark_tpu import recordio
+from tensorflowonspark_tpu.engine import LocalDataset, as_dataset
+
+logger = logging.getLogger(__name__)
+
+# provenance registry of loaded datasets (parity: dfutil.py:18-26 loadedDF)
+loaded_schemas = {}
+
+
+# -- row ⇄ Example -----------------------------------------------------------
+
+def to_example(row: dict) -> bytes:
+    """Encode one row dict as a serialized tf.train.Example."""
+    feats = {}
+    for name, value in row.items():
+        is_list = isinstance(value, (list, tuple))
+        vals = list(value) if is_list else [value]
+        if not vals:
+            feats[name] = ("float", [])
+        elif isinstance(vals[0], bool):
+            feats[name] = ("int64", [int(v) for v in vals])
+        elif isinstance(vals[0], int):
+            feats[name] = ("int64", vals)
+        elif isinstance(vals[0], float):
+            feats[name] = ("float", vals)
+        elif isinstance(vals[0], str):
+            feats[name] = ("bytes", [v.encode() for v in vals])
+        elif isinstance(vals[0], (bytes, bytearray)):
+            feats[name] = ("bytes", [bytes(v) for v in vals])
+        else:
+            import numpy as np
+
+            if isinstance(vals[0], (np.integer,)):
+                feats[name] = ("int64", [int(v) for v in vals])
+            elif isinstance(vals[0], (np.floating,)):
+                feats[name] = ("float", [float(v) for v in vals])
+            elif isinstance(vals[0], np.ndarray):
+                arr = np.asarray(vals[0])
+                if arr.dtype.kind in "iu":
+                    feats[name] = ("int64", [int(x) for x in arr.ravel()])
+                else:
+                    feats[name] = ("float", [float(x) for x in arr.ravel()])
+            else:
+                raise TypeError(f"unsupported type for {name}: {type(vals[0])}")
+    return recordio.encode_example(feats)
+
+
+def infer_schema(example_bytes: bytes, binary_features=()):
+    """{name: (kind, is_array)} from the first record
+    (parity: dfutil.infer_schema :134-168 — arrays inferred when a feature
+    holds more than one value; bytes decode as str unless hinted binary)."""
+    feats = recordio.decode_example(example_bytes)
+    schema = {}
+    for name, (kind, values) in feats.items():
+        if kind == "bytes" and name not in binary_features:
+            kind = "string"
+        schema[name] = (kind, len(values) > 1)
+    return schema
+
+
+def from_example(example_bytes: bytes, schema=None, binary_features=()) -> dict:
+    """Decode a serialized Example into a row dict."""
+    feats = recordio.decode_example(example_bytes)
+    if schema is None:
+        schema = infer_schema(example_bytes, binary_features)
+    row = {}
+    for name, (kind, values) in feats.items():
+        skind, is_array = schema.get(name, (kind, len(values) > 1))
+        if skind == "string":
+            values = [v.decode() for v in values]
+        row[name] = list(values) if is_array else (values[0] if values else None)
+    return row
+
+
+# -- save / load -------------------------------------------------------------
+
+def save_as_tfrecords(dataset_or_rows, output_dir):
+    """Write rows as sharded TFRecord files (parity: dfutil.saveAsTFRecords
+    :29-41 — one part file per partition)."""
+    os.makedirs(output_dir, exist_ok=True)
+    try:
+        ds = as_dataset(dataset_or_rows)
+    except TypeError:
+        ds = None
+    if ds is None:
+        _write_shard(dataset_or_rows, os.path.join(output_dir, "part-r-00000"))
+        return output_dir
+
+    def write_partition(it):
+        import os as _os
+
+        rows = list(it)
+        if not rows:
+            return []
+        shard = _os.path.join(
+            output_dir, f"part-r-{_os.getpid()}-{id(rows) & 0xffff:05d}"
+        )
+        _write_shard(rows, shard)
+        return [shard]
+
+    shards = ds.map_partitions(write_partition).collect()
+    logger.info("saved %d shards under %s", len(shards), output_dir)
+    return output_dir
+
+
+def _write_shard(rows, path):
+    with recordio.TFRecordWriter(path) as w:
+        for row in rows:
+            w.write(to_example(row))
+
+
+def load_tfrecords(source, input_dir, binary_features=()):
+    """Load TFRecords into a dataset of row dicts with an inferred schema
+    (parity: dfutil.loadTFRecords :44-81).
+
+    ``source``: an engine (LocalEngine/SparkEngine) used to parallelize
+    the shard list; pass None for a plain list of rows.
+    """
+    files = sorted(
+        os.path.join(input_dir, f)
+        for f in os.listdir(input_dir)
+        if f.startswith("part-") and not f.endswith(".tmp")
+    ) if os.path.isdir(input_dir) else [input_dir]
+    if not files:
+        raise FileNotFoundError(f"no TFRecord part files under {input_dir}")
+
+    first = next(iter(recordio.TFRecordReader(files[0])))
+    schema = infer_schema(first, binary_features)
+
+    def read_shard(it):
+        out = []
+        for path in it:
+            for rec in recordio.TFRecordReader(path):
+                out.append(from_example(rec, schema, binary_features))
+        return out
+
+    if source is None:
+        rows = list(read_shard(iter(files)))
+        loaded_schemas[input_dir] = schema
+        return rows, schema
+    ds = source.parallelize(files, min(len(files), source.num_executors * 2))
+    ds = ds.map_partitions(read_shard)
+    loaded_schemas[input_dir] = schema
+    return ds, schema
+
+
+def is_loaded_df(path):
+    """Provenance check (parity: dfutil.isLoadedDF :18-26): True if this
+    path was produced by load_tfrecords in this process."""
+    return path in loaded_schemas

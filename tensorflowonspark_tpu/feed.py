@@ -48,6 +48,31 @@ def _user():
     return getpass.getuser()
 
 
+def open_feed_ring(mgr, qname="input", producer=False):
+    """Open the shm fast path advertised by the node, or None.
+
+    THE transport handshake, shared by producer (feeder/shutdown closures)
+    and consumer (DataFeed): the node's KV entry 'shm_input' is the single
+    source of truth.  If a ring is advertised but cannot be opened on this
+    side, raise — a silent one-sided fallback would leave producer and
+    consumer on different transports and deadlock the feed.
+    """
+    if qname != "input":
+        return None
+    ring_name = mgr.get("shm_input")
+    if not ring_name:
+        return None
+    try:
+        from tensorflowonspark_tpu.recordio import shm as shmq
+
+        return shmq.ShmQueue(str(ring_name), create=False, producer=producer)
+    except Exception as e:
+        raise RuntimeError(
+            f"node advertised shm feed ring {ring_name!r} but this process "
+            f"cannot open it: {e}; unset TFOS_SHM_FEED to disable the fast path"
+        ) from e
+
+
 class DataFeed:
     """Consumer side of the executor feed queues (TFNode.py:221-329)."""
 
@@ -68,6 +93,18 @@ class DataFeed:
             sorted(input_mapping.values()) if input_mapping is not None else None
         )
         self._buffer = []  # leftover records from a partially-consumed chunk
+        # shm fast path; the handshake (open_feed_ring) is shared with the
+        # producer closures so both sides always agree on the transport
+        self._ring = open_feed_ring(mgr, qname_in, producer=False)
+
+    def _get_chunk(self, timeout_ms=-1):
+        """Next chunk from the fast or compat transport (blocking)."""
+        if self._ring is not None:
+            return self._ring.get(timeout_ms)
+        queue = self.mgr.get_queue(self.qname_in)
+        chunk = queue.get(block=True)
+        queue.task_done()
+        return chunk
 
     def next_batch(self, batch_size):
         """Gather up to ``batch_size`` records (TFNode.py:243-288).
@@ -78,7 +115,6 @@ class DataFeed:
         early in inference mode so results stay partition-aligned.
         """
         logger.debug("next_batch(%d) invoked", batch_size)
-        queue = self.mgr.get_queue(self.qname_in)
         tensors = (
             [] if self.input_tensors is None else {t: [] for t in self.input_tensors}
         )
@@ -97,8 +133,7 @@ class DataFeed:
             if self._buffer:
                 _append(self._buffer.pop(0))
                 continue
-            chunk = queue.get(block=True)
-            queue.task_done()
+            chunk = self._get_chunk()
             if chunk is None:
                 logger.info("next_batch() got None: end of feed")
                 self.done_feeding = True
@@ -134,11 +169,15 @@ class DataFeed:
         """
         logger.info("terminate() invoked")
         self.mgr.set("state", "terminating")
-        queue = self.mgr.get_queue(self.qname_in)
         done = False
         while not done:
             try:
-                queue.get(block=True, timeout=5)
-                queue.task_done()
-            except Exception:  # noqa: BLE001 - Empty from a proxy queue
+                if self._ring is not None:
+                    if self._ring.get(timeout_ms=5000) is None:
+                        done = True
+                else:
+                    queue = self.mgr.get_queue(self.qname_in)
+                    queue.get(block=True, timeout=5)
+                    queue.task_done()
+            except Exception:  # noqa: BLE001 - Empty/Timeout = fully drained
                 done = True

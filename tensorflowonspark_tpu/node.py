@@ -52,6 +52,7 @@ class _NodeState:
 
     mgr = None
     cluster_id = None
+    ring = None  # shm feed ring (creator side), kept alive for the cluster
 
 
 def _get_cluster_spec(cluster_info):
@@ -212,6 +213,22 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
         _NodeState.cluster_id = cluster_meta["id"]
         write_executor_id(executor_id)
 
+        # Fast same-host feed transport: a shared-memory ring for the
+        # 'input' stream (native/shmqueue.cpp).  The manager keeps
+        # control/error/output and the state machine; the ring carries the
+        # bulk record chunks with no per-chunk manager RPC.
+        if os.environ.get("TFOS_SHM_FEED", "1") != "0":
+            try:
+                from tensorflowonspark_tpu.recordio import shm as shmq
+
+                if shmq.available():
+                    ring_name = f"/tfos-{cluster_meta['id'] & 0xffffffff:x}-{executor_id}"
+                    cap = int(os.environ.get("TFOS_SHM_FEED_BYTES", str(256 << 20)))
+                    _NodeState.ring = shmq.ShmQueue(ring_name, cap, create=True)
+                    mgr.set("shm_input", ring_name)
+            except Exception as e:  # noqa: BLE001 - optional acceleration
+                logger.warning("shm feed unavailable: %s", e)
+
         # (4) rendezvous: reserve a port for the coordinator service (the
         # free-port trick, TFSparkNode.py:337-342), then register.
         client = rendezvous.Client(cluster_meta["server_addr"])
@@ -334,46 +351,74 @@ def _get_manager(cluster_info, host, executor_id):
     )
 
 
+def _open_feed_ring(mgr, qname):
+    """Producer-side handle on the shared transport handshake (feed.py)."""
+    from tensorflowonspark_tpu.feed import open_feed_ring
+
+    return open_feed_ring(mgr, qname, producer=True)
+
+
+def _await_consumption(mgr, waiter, feed_timeout, poll=1.0):
+    """Wait for the consumer to drain what we queued, polling the error
+    queue (parity: TFSparkNode.py:484-497).  ``waiter()`` returns True
+    while data is still outstanding."""
+    equeue = mgr.get_queue("error")
+    timeout = feed_timeout
+    while waiter():
+        if not equeue.empty():
+            e_str = equeue.get()
+            equeue.task_done()
+            raise RuntimeError(f"exception in worker:\n{e_str}")
+        time.sleep(poll)
+        timeout -= poll
+        if timeout <= 0:
+            raise TimeoutError("timed out waiting for consumption of partition")
+
+
 def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
-    """Feeder closure: push partition records as chunks
-    (parity: TFSparkNode.train :448-515)."""
+    """Feeder closure: push partition records as chunks over the shm ring
+    (fast path) or the manager queue (parity: TFSparkNode.train :448-515)."""
 
     def _train(iterator):
         mgr = _get_manager(cluster_info, get_ip_address(), read_executor_id())
-        queue = mgr.get_queue(qname)
         state = str(mgr.get("state"))
         if state in ("terminating", "stopped"):
             logger.info("feeder: state=%s, skipping/draining partition", state)
             count = sum(1 for _ in iterator)
             logger.info("feeder: discarded %d records", count)
             return
+        ring = _open_feed_ring(mgr, qname)
+        queue = None if ring is not None else mgr.get_queue(qname)
+
+        def put(chunk):
+            if ring is not None:
+                ring.put(chunk)
+            else:
+                queue.put(chunk, block=True)
+
         total = 0
         chunk = []
         for item in iterator:
             chunk.append(item)
             if len(chunk) >= FEED_CHUNK_RECORDS:
-                queue.put(chunk, block=True)
+                put(chunk)
                 total += len(chunk)
                 chunk = []
         if chunk:
-            queue.put(chunk, block=True)
+            put(chunk)
             total += len(chunk)
-        logger.info("feeder: queued %d records", total)
+        logger.info("feeder: queued %d records (%s path)", total,
+                    "shm" if ring is not None else "manager")
 
-        # wait for the consumer, polling the error queue (TFSparkNode.py:484-497)
-        joining = threading.Thread(target=queue.join, daemon=True)
-        joining.start()
-        equeue = mgr.get_queue("error")
-        timeout = feed_timeout
-        while joining.is_alive():
-            if not equeue.empty():
-                e_str = equeue.get()
-                equeue.task_done()
-                raise RuntimeError(f"exception in worker:\n{e_str}")
-            time.sleep(1)
-            timeout -= 1
-            if timeout <= 0:
-                raise TimeoutError("timed out waiting for consumption of partition")
+        if ring is not None:
+            _await_consumption(
+                mgr, lambda: ring.qsize_bytes() > 0, feed_timeout, poll=0.2
+            )
+            ring.close()
+        else:
+            joining = threading.Thread(target=queue.join, daemon=True)
+            joining.start()
+            _await_consumption(mgr, joining.is_alive, feed_timeout)
 
         if str(mgr.get("state")) == "terminating":
             logger.info("feeder: consumer requested termination")
@@ -389,36 +434,40 @@ def inference(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
 
     def _inference(iterator):
         mgr = _get_manager(cluster_info, get_ip_address(), read_executor_id())
-        queue = mgr.get_queue(qname)
+        ring = _open_feed_ring(mgr, qname)
+        queue = None if ring is not None else mgr.get_queue(qname)
+
+        def put(item):
+            if ring is not None:
+                ring.put(item)
+            else:
+                queue.put(item, block=True)
+
         count = 0
         chunk = []
         for item in iterator:
             chunk.append(item)
             if len(chunk) >= FEED_CHUNK_RECORDS:
-                queue.put(chunk, block=True)
+                put(chunk)
                 count += len(chunk)
                 chunk = []
         if chunk:
-            queue.put(chunk, block=True)
+            put(chunk)
             count += len(chunk)
-        queue.put(marker.EndPartition(), block=True)
-        if count == 0:
-            return []
+        put(marker.EndPartition())
 
         # await consumption with error polling
-        joining = threading.Thread(target=queue.join, daemon=True)
-        joining.start()
-        equeue = mgr.get_queue("error")
-        timeout = feed_timeout
-        while joining.is_alive():
-            if not equeue.empty():
-                e_str = equeue.get()
-                equeue.task_done()
-                raise RuntimeError(f"exception in worker:\n{e_str}")
-            time.sleep(0.2)
-            timeout -= 0.2
-            if timeout <= 0:
-                raise TimeoutError("timed out waiting for inference of partition")
+        if ring is not None:
+            _await_consumption(
+                mgr, lambda: ring.qsize_bytes() > 0, feed_timeout, poll=0.1
+            )
+            ring.close()
+        else:
+            joining = threading.Thread(target=queue.join, daemon=True)
+            joining.start()
+            _await_consumption(mgr, joining.is_alive, feed_timeout, poll=0.2)
+        if count == 0:
+            return []  # empty partition: nothing to collect
 
         # collect exactly `count` results (results arrive as chunks)
         results = []
@@ -444,13 +493,19 @@ def shutdown(cluster_info, queues, cluster_id, grace_secs=0):
         executor_id = read_executor_id()
         mgr = _get_manager(cluster_info, get_ip_address(), executor_id)
         logger.info("shutdown: signalling end-of-feed on executor %s", executor_id)
+        ring = _open_feed_ring(mgr, "input")
         for qname in queues:
             if qname in ("error", "control"):
                 continue  # end-of-feed applies to data queues only
             try:
-                mgr.get_queue(qname).put(None, block=True)
+                if qname == "input" and ring is not None:
+                    ring.put(None)
+                else:
+                    mgr.get_queue(qname).put(None, block=True)
             except Exception as e:  # noqa: BLE001
                 logger.warning("shutdown: queue %s: %s", qname, e)
+        if ring is not None:
+            ring.close()
         if grace_secs:
             time.sleep(grace_secs)
         # PEEK the error queue — get and put back — so an engine/Spark task

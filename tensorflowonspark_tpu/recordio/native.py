@@ -1,0 +1,137 @@
+"""ctypes bindings for the native record-IO / shm-queue library.
+
+Loads ``libtfos_native.so`` (built from /native via ``make``); call sites
+fall back to the pure-Python implementation (pyimpl.py) when the library
+is unavailable — behavior is identical, speed is not.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+
+logger = logging.getLogger(__name__)
+
+_LIB = None
+_TRIED = False
+
+
+def _candidates():
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(os.path.dirname(here))
+    env = os.environ.get("TFOS_NATIVE_LIB")
+    if env:
+        yield env
+    yield os.path.join(here, "libtfos_native.so")
+    yield os.path.join(repo, "native", "libtfos_native.so")
+
+
+def load():
+    """Load (and lazily build) the native library; None if unavailable."""
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    _TRIED = True
+    for path in _candidates():
+        if os.path.exists(path):
+            try:
+                _LIB = _bind(ctypes.CDLL(path))
+                logger.info("loaded native record-io: %s", path)
+                return _LIB
+            except OSError as e:  # half-written or foreign .so
+                logger.warning("cannot load %s: %s", path, e)
+    # try building once from the in-repo sources; an exclusive flock keeps
+    # N concurrently-starting executor processes from interleaving builds,
+    # and losers of the race load the winner's output
+    here = os.path.dirname(os.path.abspath(__file__))
+    src = os.path.join(os.path.dirname(os.path.dirname(here)), "native")
+    if os.path.exists(os.path.join(src, "Makefile")):
+        try:
+            import fcntl
+            import tempfile
+
+            lock = open(os.path.join(tempfile.gettempdir(), ".tfos-native-build.lock"), "w")
+            with lock:
+                fcntl.flock(lock, fcntl.LOCK_EX)
+                path = os.path.join(src, "libtfos_native.so")
+                if not os.path.exists(path):
+                    subprocess.run(["make", "-C", src], check=True,
+                                   capture_output=True)
+                if os.path.exists(path):
+                    _LIB = _bind(ctypes.CDLL(path))
+                    logger.info("built+loaded native record-io: %s", path)
+                    return _LIB
+        except Exception as e:  # noqa: BLE001 - fall back to pure python
+            logger.warning("native build failed (%s); using pure-python IO", e)
+    return None
+
+
+def _bind(lib):
+    c = ctypes
+    u8p = c.POINTER(c.c_uint8)
+
+    lib.tfr_writer_open.restype = c.c_void_p
+    lib.tfr_writer_open.argtypes = [c.c_char_p]
+    lib.tfr_writer_write.restype = c.c_int
+    lib.tfr_writer_write.argtypes = [c.c_void_p, c.c_char_p, c.c_uint64]
+    lib.tfr_writer_close.restype = c.c_int
+    lib.tfr_writer_close.argtypes = [c.c_void_p]
+
+    lib.tfr_reader_open.restype = c.c_void_p
+    lib.tfr_reader_open.argtypes = [c.c_char_p]
+    lib.tfr_reader_next.restype = c.c_int64
+    lib.tfr_reader_next.argtypes = [c.c_void_p, c.POINTER(u8p)]
+    lib.tfr_reader_close.restype = c.c_int
+    lib.tfr_reader_close.argtypes = [c.c_void_p]
+
+    lib.exb_new.restype = c.c_void_p
+    lib.exb_free.argtypes = [c.c_void_p]
+    lib.exb_add_int64.argtypes = [c.c_void_p, c.c_char_p,
+                                  c.POINTER(c.c_int64), c.c_int]
+    lib.exb_add_float.argtypes = [c.c_void_p, c.c_char_p,
+                                  c.POINTER(c.c_float), c.c_int]
+    lib.exb_add_bytes.argtypes = [c.c_void_p, c.c_char_p,
+                                  c.POINTER(c.c_char_p),
+                                  c.POINTER(c.c_uint64), c.c_int]
+    lib.exb_serialize.restype = u8p
+    lib.exb_serialize.argtypes = [c.c_void_p, c.POINTER(c.c_uint64)]
+
+    lib.exd_parse.restype = c.c_void_p
+    lib.exd_parse.argtypes = [c.c_char_p, c.c_uint64]
+    lib.exd_free.argtypes = [c.c_void_p]
+    lib.exd_num_features.restype = c.c_int
+    lib.exd_num_features.argtypes = [c.c_void_p]
+    lib.exd_name.restype = c.c_char_p
+    lib.exd_name.argtypes = [c.c_void_p, c.c_int]
+    lib.exd_kind.restype = c.c_int
+    lib.exd_kind.argtypes = [c.c_void_p, c.c_int]
+    lib.exd_value_count.restype = c.c_int64
+    lib.exd_value_count.argtypes = [c.c_void_p, c.c_int]
+    lib.exd_floats.restype = c.POINTER(c.c_float)
+    lib.exd_floats.argtypes = [c.c_void_p, c.c_int]
+    lib.exd_int64s.restype = c.POINTER(c.c_int64)
+    lib.exd_int64s.argtypes = [c.c_void_p, c.c_int]
+    lib.exd_bytes.restype = u8p
+    lib.exd_bytes.argtypes = [c.c_void_p, c.c_int, c.c_int,
+                              c.POINTER(c.c_uint64)]
+
+    lib.shq_create.restype = c.c_void_p
+    lib.shq_create.argtypes = [c.c_char_p, c.c_uint64]
+    lib.shq_open.restype = c.c_void_p
+    lib.shq_open.argtypes = [c.c_char_p, c.c_int]
+    lib.shq_push.restype = c.c_int
+    lib.shq_push.argtypes = [c.c_void_p, c.c_char_p, c.c_uint64, c.c_int]
+    lib.shq_pop.restype = c.c_int64
+    lib.shq_pop.argtypes = [c.c_void_p, c.c_int]
+    lib.shq_buffer.restype = u8p
+    lib.shq_buffer.argtypes = [c.c_void_p]
+    lib.shq_close_write.argtypes = [c.c_void_p]
+    lib.shq_size.restype = c.c_uint64
+    lib.shq_size.argtypes = [c.c_void_p]
+    lib.shq_free.argtypes = [c.c_void_p]
+
+    lib.tfr_crc32c.restype = c.c_uint32
+    lib.tfr_crc32c.argtypes = [c.c_char_p, c.c_uint64]
+    return lib

@@ -1,0 +1,102 @@
+"""Shared-memory ring queue binding (native/shmqueue.cpp).
+
+The fast same-host feed path: the feeder pushes serialized record chunks
+into a SPSC byte ring in POSIX shm; the training process pops them with
+no per-record IPC and no manager round-trips.  Used by the feed layer as
+an accelerated transport when the native library is present; the manager
+queue remains the control/compat path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+
+from tensorflowonspark_tpu.recordio import native as _native
+
+
+class ShmQueue:
+    """Producer or consumer endpoint of a named shm ring.
+
+    The ring is single-producer/single-consumer; pass ``producer=True``
+    when opening as a writer — an exclusive flock serializes producer
+    sessions (e.g. concurrent feeder tasks on a multi-core Spark
+    executor), matching the multi-producer safety of the manager queue
+    it replaces."""
+
+    def __init__(self, name, capacity=64 << 20, create=False,
+                 open_timeout_ms=60000, producer=False):
+        lib = _native.load()
+        if lib is None:
+            raise RuntimeError("native library unavailable; ShmQueue disabled")
+        self._lib = lib
+        self.name = name
+        self._lockf = None
+        if producer and not create:
+            import fcntl
+            import tempfile
+
+            lockpath = os.path.join(
+                tempfile.gettempdir(), f".tfosq{name.replace('/', '_')}.lock"
+            )
+            self._lockf = open(lockpath, "w")
+            fcntl.flock(self._lockf, fcntl.LOCK_EX)
+        if create:
+            self._h = lib.shq_create(name.encode(), capacity)
+        else:
+            self._h = lib.shq_open(name.encode(), open_timeout_ms)
+        if not self._h:
+            if self._lockf:
+                self._lockf.close()
+            raise OSError(f"cannot {'create' if create else 'open'} shm queue {name}")
+
+    def put_bytes(self, data: bytes, timeout_ms=-1):
+        rc = self._lib.shq_push(self._h, data, len(data), timeout_ms)
+        if rc == -1:
+            raise TimeoutError(f"shm queue {self.name} full")
+        if rc == -2:
+            raise BrokenPipeError(f"shm queue {self.name} closed")
+        if rc == -3:
+            raise ValueError("message larger than ring capacity")
+
+    def get_bytes(self, timeout_ms=-1):
+        """Returns payload bytes (possibly b""), or None at EOF."""
+        n = self._lib.shq_pop(self._h, timeout_ms)
+        if n == -1:
+            raise TimeoutError(f"shm queue {self.name} empty")
+        if n == -2:
+            return None  # closed and drained
+        return ctypes.string_at(self._lib.shq_buffer(self._h), n) if n else b""
+
+    def put(self, obj, timeout_ms=-1):
+        self.put_bytes(pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
+                       timeout_ms)
+
+    def get(self, timeout_ms=-1):
+        data = self.get_bytes(timeout_ms)
+        return None if data is None else pickle.loads(data)
+
+    def close_write(self):
+        self._lib.shq_close_write(self._h)
+
+    def qsize_bytes(self):
+        return self._lib.shq_size(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.shq_free(self._h)
+            self._h = None
+        if self._lockf:
+            self._lockf.close()  # releases the producer flock
+            self._lockf = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def available():
+    return _native.load() is not None

@@ -1,0 +1,96 @@
+"""Shared-memory ring queue tests: cross-process, wrap-around, EOF."""
+
+import multiprocessing as mp
+import os
+import time
+
+import pytest
+
+from tensorflowonspark_tpu.recordio import shm
+
+pytestmark = pytest.mark.skipif(not shm.available(), reason="no native lib")
+
+
+def test_basic_roundtrip():
+    q = shm.ShmQueue(f"/tfosq-test-{os.getpid()}-a", capacity=1 << 16, create=True)
+    try:
+        q.put({"x": 1, "data": b"abc"})
+        q.put_bytes(b"raw")
+        q.put_bytes(b"")  # empty payload is data, not EOF
+        assert q.get() == {"x": 1, "data": b"abc"}
+        assert q.get_bytes() == b"raw"
+        assert q.get_bytes() == b""
+        q.close_write()
+        assert q.get() is None  # EOF after close + drain
+    finally:
+        q.close()
+
+
+def test_wraparound_many_messages():
+    q = shm.ShmQueue(f"/tfosq-test-{os.getpid()}-b", capacity=1 << 12, create=True)
+    try:
+        payload = b"z" * 500
+        for i in range(100):  # far more data than capacity; interleave
+            q.put_bytes(payload + str(i).encode(), timeout_ms=1000)
+            got = q.get_bytes(timeout_ms=1000)
+            assert got == payload + str(i).encode()
+    finally:
+        q.close()
+
+
+def test_full_queue_times_out():
+    q = shm.ShmQueue(f"/tfosq-test-{os.getpid()}-c", capacity=1 << 12, create=True)
+    try:
+        with pytest.raises(ValueError):
+            q.put_bytes(b"x" * (1 << 13))  # bigger than ring
+        q.put_bytes(b"x" * 3000)
+        with pytest.raises(TimeoutError):
+            q.put_bytes(b"y" * 3000, timeout_ms=100)
+    finally:
+        q.close()
+
+
+def _producer(name, n):
+    q = shm.ShmQueue(name, create=False)
+    for i in range(n):
+        q.put_bytes(b"msg-%06d" % i)
+    q.close_write()
+    q.close()
+
+
+def test_cross_process_stream():
+    name = f"/tfosq-test-{os.getpid()}-d"
+    q = shm.ShmQueue(name, capacity=1 << 14, create=True)
+    try:
+        n = 5000
+        ctx = mp.get_context("spawn")
+        p = ctx.Process(target=_producer, args=(name, n))
+        p.start()
+        got = 0
+        while True:
+            data = q.get_bytes(timeout_ms=30000)
+            if data is None:
+                break
+            assert data == b"msg-%06d" % got
+            got += 1
+        assert got == n
+        p.join(10)
+        assert p.exitcode == 0
+    finally:
+        q.close()
+
+
+def test_throughput_smoke():
+    """The ring should move >500 MB/s same-process (sanity, not a bench)."""
+    q = shm.ShmQueue(f"/tfosq-test-{os.getpid()}-e", capacity=64 << 20, create=True)
+    try:
+        chunk = b"x" * (1 << 20)
+        t0 = time.perf_counter()
+        for _ in range(64):
+            q.put_bytes(chunk)
+            q.get_bytes()
+        dt = time.perf_counter() - t0
+        mbps = 64 / dt
+        assert mbps > 100, f"shm ring too slow: {mbps:.0f} MB/s"
+    finally:
+        q.close()
