@@ -88,34 +88,44 @@ def _executor_main(index, workdir, shared_inbox, own_inbox, results):
 # Dataset (RDD parity surface)
 # ----------------------------------------------------------------------------
 
-class LocalDataset:
-    """Partitioned dataset with a lazy map_partitions lineage (RDD parity)."""
+def _compose(parent_fn, fn):
+    if parent_fn is None:
+        return fn
 
-    def __init__(self, engine, partitions, lineage=None):
+    def composed(it, _pf=parent_fn, _f=fn):
+        return _f(iter(list(_pf(it))))
+
+    return composed
+
+
+class LocalDataset:
+    """Partitioned dataset with a lazy map_partitions lineage (RDD parity).
+
+    Internally a dataset resolves to *tasks*: one ``(items, fn|None)``
+    pair per partition, so unions of differently-derived datasets (e.g.
+    the epoch-union of a column projection, TFCluster.train parity) keep
+    each branch's transform chain."""
+
+    def __init__(self, engine, partitions, lineage=None, tasks=None):
         self._engine = engine
         self._partitions = partitions  # list[list] or None when derived
         self._lineage = lineage        # (parent: LocalDataset, fn)
+        self._tasks_cache = tasks      # list[(items, fn|None)] (union result)
 
     # -- lineage resolution ---------------------------------------------------
-    def _resolve(self):
-        """Return (base_partitions, composed_fn or None)."""
+    def _tasks(self):
+        """Resolve to per-partition (items, composed_fn|None) tasks."""
+        if self._tasks_cache is not None:
+            return list(self._tasks_cache)
         if self._lineage is None:
-            return self._partitions, None
+            return [(p, None) for p in self._partitions]
         parent, fn = self._lineage
-        base, parent_fn = parent._resolve()
-        if parent_fn is None:
-            return base, fn
-
-        def composed(it, _pf=parent_fn, _f=fn):
-            return _f(iter(list(_pf(it))))
-
-        return base, composed
+        return [(items, _compose(pfn, fn)) for items, pfn in parent._tasks()]
 
     # -- RDD-like API ---------------------------------------------------------
     @property
     def num_partitions(self):
-        base, _ = self._resolve()
-        return len(base)
+        return len(self._tasks())
 
     def map_partitions(self, fn):
         return LocalDataset(self._engine, None, lineage=(self, fn))
@@ -124,22 +134,25 @@ class LocalDataset:
         """Run fn over partitions.  ``placement`` pins task i to executor
         placement[i] (used so shutdown signals reach the executor that owns
         each node's manager — Spark gets this from locality)."""
-        base, chain = self._resolve()
-        if chain is not None:
-            def run(it, _c=chain, _f=fn):
-                _f(iter(list(_c(it))))
+
+        def run(fn_, chain):
+            def _run(it, _c=chain, _f=fn_):
+                _f(iter(list(_c(it))) if _c is not None else it)
                 return None
-        else:
-            run = fn
-        self._engine._run_job(
-            base, run, collect=False, spread=spread, placement=placement
-        )
+
+            return _run
+
+        tasks = [(items, run(fn, chain)) for items, chain in self._tasks()]
+        self._engine._run_job(tasks, collect=False, spread=spread,
+                              placement=placement)
 
     def collect(self):
-        base, chain = self._resolve()
-        fn = chain if chain is not None else (lambda it: list(it))
+        tasks = [
+            (items, chain if chain is not None else (lambda it: list(it)))
+            for items, chain in self._tasks()
+        ]
         parts = self._engine._run_job(
-            base, fn, collect=True, spread=False, placement=None
+            tasks, collect=True, spread=False, placement=None
         )
         out = []
         for p in parts:
@@ -147,14 +160,10 @@ class LocalDataset:
         return out
 
     def union(self, *others):
-        base, chain = self._resolve()
-        assert chain is None, "union on derived datasets not supported"
-        parts = list(base)
+        tasks = self._tasks()
         for o in others:
-            obase, ochain = o._resolve()
-            assert ochain is None
-            parts.extend(obase)
-        return LocalDataset(self._engine, parts)
+            tasks.extend(o._tasks())
+        return LocalDataset(self._engine, None, tasks=tasks)
 
 
 # ----------------------------------------------------------------------------
@@ -272,8 +281,8 @@ class LocalEngine:
                 q.put(item)
             # results for finished/cancelled jobs are dropped
 
-    def _run_job(self, partitions, fn, collect, spread, placement=None):
-        """Dispatch one task per partition; block until all complete."""
+    def _run_job(self, tasks, collect, spread, placement=None):
+        """Dispatch one (items, fn) task per partition; block until done."""
         if self._cancelled:
             raise TaskError("engine cancelled")
         with self._job_lock:
@@ -285,8 +294,8 @@ class LocalEngine:
         # to an earlier job must not fail work the survivors can finish.
         dead_at_start = {i for i, p in enumerate(self._procs) if not p.is_alive()}
         try:
-            ntasks = len(partitions)
-            for task_id, part in enumerate(partitions):
+            ntasks = len(tasks)
+            for task_id, (part, fn) in enumerate(tasks):
                 blob = cloudpickle.dumps((fn, list(part), collect))
                 msg = ("task", job_id, task_id, blob)
                 if placement is not None and task_id < len(placement):

@@ -1,0 +1,49 @@
+"""Linear regression — the smallest model family.
+
+The reference's pipeline CI gate trains exactly this shape (Keras
+Dense(1) on two features, test_pipeline.py:89-172); kept here both as
+that parity workload and as the simplest exported-predict example.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def init_params(key=None, dim=2, dtype=jnp.float32):
+    del key  # zero init is standard for linear regression
+    return {"w": jnp.zeros((dim,), dtype), "b": jnp.zeros((), dtype)}
+
+
+def apply(params, x):
+    return jnp.asarray(x) @ params["w"] + params["b"]
+
+
+def make_train_step(optimizer):
+    """(params, opt_state, x, y) -> (params, opt_state, loss); jittable."""
+
+    def loss_fn(params, x, y):
+        pred = apply(params, x)
+        return jnp.mean((pred - y) ** 2)
+
+    def step(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+def predict(params, inputs):
+    """Export predict signature: {tensor_name: ndarray} -> predictions.
+
+    Referenced from export metadata as
+    ``tensorflowonspark_tpu.models.linear:predict``.
+    """
+    import numpy as np
+
+    (x,) = inputs.values()
+    return np.asarray(apply(params, np.asarray(x, dtype=np.float32)))

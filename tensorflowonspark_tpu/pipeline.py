@@ -1,0 +1,583 @@
+"""ML-pipeline layer: Estimator/Model with typed Params over the cluster
+runtime (parity: reference tensorflowonspark/pipeline.py, 710 LoC).
+
+The reference builds on ``pyspark.ml`` — ``TFEstimator.fit(df)`` spins a
+TFCluster in InputMode.SPARK, feeds the DataFrame, and returns a
+``TFModel`` whose ``transform`` runs cached single-node inference per
+executor (pipeline.py:351-489,585-644).  This module keeps that exact
+user surface — ``Has*`` mixins with ``setX/getX``, ``Namespace`` argument
+unification, params-over-args merging (pipeline.py:339-348) — but is
+self-contained: the Params machinery below has no pyspark dependency, and
+when a real ``pyspark.ml`` Estimator is wanted the same classes accept
+Spark DataFrames (``.rdd`` ducks into the engine Dataset contract).
+
+TPU-native inference design: instead of a SavedModel signature looked up
+by ``signature_def_key`` (pipeline.py:664-685), an export directory
+(utils/checkpoint.export_model) carries the params pytree plus metadata
+naming a ``predict`` function (``"module:qualname"``); the per-worker
+cache jits it once and reuses it across partitions — the analogue of the
+reference's per-python-worker model cache (pipeline.py:492-496).
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy as _copy
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Lightweight Spark-ML-style Params machinery (no pyspark dependency)
+# ---------------------------------------------------------------------------
+
+class Param:
+    """A typed, documented parameter owned by a Params class."""
+
+    def __init__(self, name, doc, converter=None):
+        self.name = name
+        self.doc = doc
+        self.converter = converter
+
+    def __repr__(self):
+        return f"Param({self.name})"
+
+
+class TypeConverters:
+    """Coercions for Param values (parity: pyspark TypeConverters +
+    the reference's custom toDict, pipeline.py:39-46)."""
+
+    @staticmethod
+    def toInt(v):
+        return int(v)
+
+    @staticmethod
+    def toFloat(v):
+        return float(v)
+
+    @staticmethod
+    def toString(v):
+        return str(v)
+
+    @staticmethod
+    def toBoolean(v):
+        if isinstance(v, str):
+            return v.lower() in ("1", "true", "yes")
+        return bool(v)
+
+    @staticmethod
+    def toDict(v):
+        if not isinstance(v, dict):
+            raise TypeError(f"expected dict, got {type(v)}")
+        return v
+
+
+class Params:
+    """Base class managing a param map with defaults.
+
+    Mirrors the pyspark.ml.param.Params surface used by the reference
+    (``_set``, ``_setDefault``, ``getOrDefault``, ``extractParamMap``,
+    ``copy``) so Estimator/Model subclasses read identically.
+    """
+
+    def __init__(self):
+        self._paramMap = {}
+        self._defaultParamMap = {}
+
+    @property
+    def params(self):
+        out = []
+        for klass in type(self).__mro__:
+            for name, val in vars(klass).items():
+                if isinstance(val, Param):
+                    out.append(val)
+        return out
+
+    def _param(self, name):
+        for p in self.params:
+            if p.name == name:
+                return p
+        raise KeyError(f"no param {name} on {type(self).__name__}")
+
+    def _set(self, **kwargs):
+        for name, value in kwargs.items():
+            p = self._param(name)
+            if value is not None and p.converter is not None:
+                value = p.converter(value)
+            self._paramMap[p] = value
+        return self
+
+    def _setDefault(self, **kwargs):
+        for name, value in kwargs.items():
+            self._defaultParamMap[self._param(name)] = value
+        return self
+
+    def isDefined(self, param):
+        p = self._param(param) if isinstance(param, str) else param
+        return p in self._paramMap or p in self._defaultParamMap
+
+    def getOrDefault(self, param):
+        p = self._param(param) if isinstance(param, str) else param
+        if p in self._paramMap:
+            return self._paramMap[p]
+        return self._defaultParamMap[p]
+
+    def extractParamMap(self):
+        out = dict(self._defaultParamMap)
+        out.update(self._paramMap)
+        return out
+
+    def copy(self, extra=None):
+        dup = _copy.copy(self)
+        dup._paramMap = dict(self._paramMap)
+        dup._defaultParamMap = dict(self._defaultParamMap)
+        for key, value in (extra or {}).items():
+            # accept Param objects or plain names ({'epochs': 3})
+            dup._set(**{key.name if isinstance(key, Param) else key: value})
+        return dup
+
+
+def _mixin(name, doc, converter, default=None, _sentinel=object()):
+    """Build a Has<name> mixin with Param + setter/getter, mirroring the
+    reference's ~20 hand-written mixins (pipeline.py:49-293)."""
+
+    def snake_to_camel(s):
+        return "".join(w.capitalize() for w in s.split("_"))
+
+    param = Param(name, doc, converter)
+
+    def _init(self):
+        Params.__init__(self) if not hasattr(self, "_paramMap") else None
+        self._setDefault(**{name: default})
+
+    def _setter(self, value):
+        return self._set(**{name: value})
+
+    def _getter(self):
+        return self.getOrDefault(name)
+
+    cls = type(
+        f"Has{snake_to_camel(name)}",
+        (Params,),
+        {
+            name: param,
+            "__init__": _init,
+            f"set{snake_to_camel(name)}": _setter,
+            f"get{snake_to_camel(name)}": _getter,
+        },
+    )
+    return cls
+
+
+HasBatchSize = _mixin("batch_size", "Number of records per batch", TypeConverters.toInt, 128)
+HasClusterSize = _mixin("cluster_size", "Number of nodes in the cluster", TypeConverters.toInt, 1)
+HasEpochs = _mixin("epochs", "Number of epochs to train", TypeConverters.toInt, 1)
+HasGraceSecs = _mixin(
+    "grace_secs",
+    "Seconds to wait after feeding (for final tasks like model export)",
+    TypeConverters.toInt, 30,
+)
+HasInputMapping = _mixin(
+    "input_mapping", "Mapping of input column to input tensor", TypeConverters.toDict
+)
+HasInputMode = _mixin(
+    "input_mode", "Input feeding mode (0=TENSORFLOW, 1=SPARK)", TypeConverters.toInt, 1
+)
+HasMasterNode = _mixin(
+    "master_node", "Job name of the master/chief node", TypeConverters.toString, "chief"
+)
+HasModelDir = _mixin(
+    "model_dir", "Path to save/load model checkpoints", TypeConverters.toString
+)
+HasExportDir = _mixin("export_dir", "Directory to export the model", TypeConverters.toString)
+HasOutputMapping = _mixin(
+    "output_mapping", "Mapping of output tensor to output column", TypeConverters.toDict
+)
+HasProtocol = _mixin(
+    "protocol",
+    "Network protocol (accepted for reference compat; data-plane is ICI/DCN)",
+    TypeConverters.toString, "grpc",
+)
+HasReaders = _mixin("readers", "Number of reader/enqueue threads", TypeConverters.toInt, 1)
+HasSteps = _mixin("steps", "Maximum number of steps to train", TypeConverters.toInt, 1000)
+HasTensorboard = _mixin(
+    "tensorboard", "Launch TensorBoard on the chief node", TypeConverters.toBoolean, False
+)
+HasTFRecordDir = _mixin(
+    "tfrecord_dir",
+    "Path to temporarily export a DataFrame as TFRecords (InputMode.TENSORFLOW apps)",
+    TypeConverters.toString,
+)
+HasSignatureDefKey = _mixin(
+    "signature_def_key",
+    "Identifier of the exported predict function (overrides export metadata)",
+    TypeConverters.toString,
+)
+HasTagSet = _mixin(
+    "tag_set", "Comma-delimited tags identifying an export variant", TypeConverters.toString
+)
+HasNumPS = _mixin("num_ps", "Number of PS nodes in the cluster", TypeConverters.toInt, 0)
+HasDriverPSNodes = _mixin(
+    "driver_ps_nodes", "Run PS nodes on the driver", TypeConverters.toBoolean, False
+)
+HasNumChips = _mixin(
+    "num_chips", "TPU chips claimed per executor (gpu-count analogue)",
+    TypeConverters.toInt, 0,
+)
+
+
+class Namespace:
+    """Dict / argv / argparse.Namespace unifier (pipeline.py:296-336).
+
+    ``Namespace({'a': 1})``, ``Namespace(ns)``, ``Namespace(['--a','1'])``
+    all expose attribute access plus ``argv`` round-tripping for user
+    mains that re-parse ``sys.argv``.
+    """
+
+    def __init__(self, d=None, **kwargs):
+        self.argv = None
+        if isinstance(d, list):
+            self.argv = list(d)
+        elif isinstance(d, dict):
+            self.__dict__.update(d)
+        elif isinstance(d, Namespace):
+            self.__dict__.update(vars(d))
+            self.argv = d.argv
+        elif isinstance(d, argparse.Namespace):
+            self.__dict__.update(vars(d))
+        elif d is not None:
+            raise TypeError(f"unsupported args type: {type(d)}")
+        self.__dict__.update(kwargs)
+
+    def __contains__(self, key):
+        return key in self.__dict__
+
+    def __getitem__(self, key):
+        return self.__dict__[key]
+
+    def __iter__(self):
+        return iter(self.__dict__)
+
+    def items(self):
+        return {k: v for k, v in self.__dict__.items() if k != "argv"}.items()
+
+    def __repr__(self):
+        return f"Namespace({self.__dict__})"
+
+
+class TFParams(Params):
+    """Shared behavior: fold current Param values over user args
+    (pipeline.py:339-348; params win)."""
+
+    args = None
+
+    def merge_args_params(self):
+        args = Namespace(self.args)
+        for param, value in self.extractParamMap().items():
+            setattr(args, param.name, value)
+        return args
+
+
+# ---------------------------------------------------------------------------
+# Estimator
+# ---------------------------------------------------------------------------
+
+class TFEstimator(
+    TFParams,
+    HasBatchSize,
+    HasClusterSize,
+    HasEpochs,
+    HasGraceSecs,
+    HasInputMapping,
+    HasInputMode,
+    HasMasterNode,
+    HasModelDir,
+    HasExportDir,
+    HasNumPS,
+    HasDriverPSNodes,
+    HasNumChips,
+    HasProtocol,
+    HasReaders,
+    HasSteps,
+    HasTensorboard,
+    HasTFRecordDir,
+):
+    """Trains a model on a dataset and returns a TFModel
+    (parity: pipeline.TFEstimator :351-432).
+
+    ``train_fn(args, ctx)`` is the standard user main; ``export_fn`` is an
+    optional driver-side post-export hook.
+    """
+
+    def __init__(self, train_fn, tf_args=None, export_fn=None):
+        Params.__init__(self)
+        self.train_fn = train_fn
+        self.export_fn = export_fn
+        self.args = Namespace(tf_args if tf_args is not None else {})
+        for klass in type(self).__mro__:
+            if klass not in (TFEstimator, Params, TFParams, object):
+                init = vars(klass).get("__init__")
+                if init is not None:
+                    init(self)
+
+    def fit(self, dataset, params=None):
+        if params:
+            return self.copy(params).fit(dataset)
+        return self._fit(dataset)
+
+    def _fit(self, dataset):
+        from tensorflowonspark_tpu import cluster as tfcluster
+
+        args = self.merge_args_params()
+        logger.info("fit: args=%s", args)
+
+        engine, feed_ds = _dataset_and_engine(dataset)
+        if args.input_mode == tfcluster.InputMode.TENSORFLOW:
+            # export the dataset as TFRecords for direct-read mains
+            # (parity: reference pipeline.py InputMode.TENSORFLOW branch)
+            assert args.tfrecord_dir, (
+                "InputMode.TENSORFLOW requires tfrecord_dir for temporary export"
+            )
+            from tensorflowonspark_tpu import dfutil
+
+            logger.info("exporting dataset to %s", args.tfrecord_dir)
+            dfutil.save_as_tfrecords(feed_ds, args.tfrecord_dir)
+        elif getattr(args, "input_mapping", None):
+            # order feed columns by *tensor name* so DataFeed's
+            # sorted-by-tensor unpacking (feed.py) aligns by construction
+            input_cols = [
+                col for col, _t in sorted(args.input_mapping.items(),
+                                          key=lambda kv: kv[1])
+            ]
+            feed_ds = _select_columns(feed_ds, input_cols)
+
+        local_cluster = tfcluster.run(
+            engine,
+            self.train_fn,
+            args,
+            num_executors=args.cluster_size,
+            num_ps=args.num_ps,
+            driver_ps_nodes=args.driver_ps_nodes,
+            tensorboard=args.tensorboard,
+            input_mode=args.input_mode,
+            master_node=args.master_node,
+            num_chips=args.num_chips,
+        )
+        if args.input_mode == tfcluster.InputMode.SPARK:
+            local_cluster.train(feed_ds, args.epochs)
+        local_cluster.shutdown(grace_secs=args.grace_secs)
+
+        if self.export_fn:
+            assert args.export_dir, "export_fn requires export_dir"
+            self.export_fn(args)
+
+        # carry over shared params without clobbering TFModel-only params
+        # (output_mapping / signature_def_key / tag_set keep their defaults)
+        model = TFModel(self.args)
+        model_params = {p.name for p in model.params}
+        model._defaultParamMap.update(
+            {p: v for p, v in self._defaultParamMap.items() if p.name in model_params}
+        )
+        model._paramMap.update(
+            {p: v for p, v in self._paramMap.items() if p.name in model_params}
+        )
+        return model
+
+
+# ---------------------------------------------------------------------------
+# Model (cached single-node batch inference)
+# ---------------------------------------------------------------------------
+
+# per-python-worker model cache (parity: pipeline.py:492-496 globals)
+_model_cache = {}
+
+
+class TFModel(
+    TFParams,
+    HasBatchSize,
+    HasInputMapping,
+    HasOutputMapping,
+    HasModelDir,
+    HasExportDir,
+    HasSignatureDefKey,
+    HasTagSet,
+):
+    """Transforms a dataset with an exported model, one cached model per
+    python worker (parity: pipeline.TFModel :435-489 + _run_model_tf2
+    :585-644)."""
+
+    def __init__(self, tf_args=None):
+        Params.__init__(self)
+        self.args = Namespace(tf_args if tf_args is not None else {})
+        for klass in type(self).__mro__:
+            if klass not in (TFModel, Params, TFParams, object):
+                init = vars(klass).get("__init__")
+                if init is not None:
+                    init(self)
+
+    def transform(self, dataset, params=None):
+        if params:
+            return self.copy(params).transform(dataset)
+        args = self.merge_args_params()
+        assert getattr(args, "export_dir", None) or getattr(args, "model_dir", None), (
+            "TFModel requires export_dir or model_dir"
+        )
+        logger.info("transform: args=%s", args)
+        _, ds = _dataset_and_engine(dataset, need_engine=False)
+
+        input_cols = sorted(args.input_mapping) if args.input_mapping else None
+        if input_cols is not None:
+            ds = _select_columns(ds, input_cols)
+        return ds.map_partitions(_run_model(args))
+
+
+def _run_model(args):
+    """Partition closure: cached model, batched predict
+    (parity: _run_model_tf2, pipeline.py:585-644)."""
+
+    def _predict_partition(iterator):
+        import numpy as np
+
+        input_tensors = (
+            [v for _, v in sorted(args.input_mapping.items())]
+            if getattr(args, "input_mapping", None) else None
+        )
+        out_pairs = (
+            sorted(args.output_mapping.items())
+            if getattr(args, "output_mapping", None) else None
+        )
+
+        export_dir = getattr(args, "export_dir", None) or args.model_dir
+        key = (export_dir, getattr(args, "signature_def_key", None))
+        if key not in _model_cache:
+            _model_cache[key] = _load_predictor(export_dir, args)
+            logger.info("loaded model %s into worker cache", key)
+        predict, params = _model_cache[key]
+
+        results = []
+        for batch in yield_batch(iterator, args.batch_size):
+            if input_tensors is None:
+                inputs = {"inputs": np.asarray(batch)}
+            else:
+                cols = list(zip(*batch)) if batch and isinstance(
+                    batch[0], (tuple, list)
+                ) else [batch]
+                inputs = {
+                    t: np.asarray(cols[i]) for i, t in enumerate(input_tensors)
+                }
+            outputs = predict(params, inputs)
+            if not isinstance(outputs, dict):
+                name = out_pairs[0][0] if out_pairs else "outputs"
+                outputs = {name: outputs}
+            outputs = {k: np.asarray(v) for k, v in outputs.items()}
+            n = len(batch)
+            for v in outputs.values():
+                assert len(v) == n, f"output rows {len(v)} != input rows {n}"
+            names = [t for t, _ in out_pairs] if out_pairs else sorted(outputs)
+            cols_out = [_column(outputs[t]) for t in names]
+            out_names = [c for _, c in out_pairs] if out_pairs else names
+            for i in range(n):
+                results.append({c: col[i] for c, col in zip(out_names, cols_out)})
+        return results
+
+    return _predict_partition
+
+
+def _column(arr):
+    """ndarray → list of python scalars / lists (row-major)."""
+    if arr.ndim <= 1:
+        return arr.tolist()
+    return [row.tolist() for row in arr]
+
+
+def _load_predictor(export_dir, args):
+    """Resolve (predict_fn, params) from an export directory.
+
+    The export metadata's ``predict`` entry ("module:qualname", the
+    SavedModel-signature analogue) is overridable by the
+    ``signature_def_key`` param; the resolved callable receives
+    ``(params, {tensor_name: ndarray})``.
+    """
+    import importlib
+
+    from tensorflowonspark_tpu.utils.checkpoint import load_exported
+
+    params, meta = load_exported(export_dir)
+    spec = getattr(args, "signature_def_key", None) or meta.get("predict")
+    if not spec:
+        raise ValueError(
+            f"export {export_dir} has no 'predict' metadata; set "
+            "signature_def_key='module:function' on the TFModel"
+        )
+    mod_name, _, fn_name = spec.partition(":")
+    fn = importlib.import_module(mod_name)
+    for part in fn_name.split("."):
+        fn = getattr(fn, part)
+    return fn, params
+
+
+def yield_batch(iterator, batch_size):
+    """Group an iterator into lists of at most batch_size rows
+    (parity: pipeline.yield_batch :688-710)."""
+    batch = []
+    for item in iterator:
+        batch.append(item)
+        if len(batch) >= batch_size:
+            yield batch
+            batch = []
+    if batch:
+        yield batch
+
+
+# ---------------------------------------------------------------------------
+# dataset plumbing
+# ---------------------------------------------------------------------------
+
+def _dataset_and_engine(dataset, need_engine=True):
+    """Accept a framework Dataset, (engine, rows) pair, or a Spark
+    DataFrame; return (engine, Dataset)."""
+    from tensorflowonspark_tpu.engine import LocalDataset, SparkDataset, SparkEngine
+
+    if isinstance(dataset, tuple) and len(dataset) == 2:
+        engine, rows = dataset
+        return engine, engine.parallelize(rows) if isinstance(rows, list) else rows
+    if isinstance(dataset, LocalDataset):
+        return dataset._engine, dataset
+    if isinstance(dataset, SparkDataset):
+        ctx = dataset.rdd.context
+        return SparkEngine(ctx), dataset
+    cls = type(dataset)
+    if cls.__module__.startswith("pyspark.sql") and cls.__name__ == "DataFrame":
+        rdd = dataset.rdd
+        return SparkEngine(rdd.context), SparkDataset(rdd)
+    if cls.__module__.startswith("pyspark") and cls.__name__ == "RDD":
+        return SparkEngine(dataset.context), SparkDataset(dataset)
+    raise TypeError(f"unsupported dataset type: {cls}")
+
+
+def _select_columns(ds, cols):
+    """Project rows (dicts or Spark Rows) down to tuples of ``cols`` in
+    order (parity: dataset.select(sorted(input_cols)).rdd,
+    pipeline.py:411-413)."""
+
+    def project(it):
+        out = []
+        for row in it:
+            if isinstance(row, dict):
+                out.append(tuple(row[c] for c in cols))
+            elif hasattr(row, "asDict"):
+                d = row.asDict()
+                out.append(tuple(d[c] for c in cols))
+            elif isinstance(row, (tuple, list)) and len(row) == len(cols):
+                # already projected/ordered by the caller
+                out.append(tuple(row))
+            else:
+                raise TypeError(
+                    f"cannot project columns {cols} from row {row!r}; "
+                    "rows must be dicts, Rows, or pre-ordered tuples of "
+                    "matching arity"
+                )
+        return out
+
+    return ds.map_partitions(project)

@@ -1,0 +1,175 @@
+"""Pipeline-layer tests (parity: reference test/test_pipeline.py).
+
+- Namespace / Params / merge_args_params semantics (:48-87)
+- full TFEstimator.fit (linear regression over 2 executor processes,
+  DataFeed, chief-only export) -> TFModel.transform, prediction ==
+  w1 + w2 to 2 decimals (:89-172)
+"""
+
+import argparse
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.pipeline import (
+    Namespace,
+    TFEstimator,
+    TFModel,
+    yield_batch,
+)
+
+W1, W2 = 3.14, 1.618
+
+
+# -- Namespace / params unit tests ------------------------------------------
+
+def test_namespace_from_dict():
+    ns = Namespace({"a": 1, "b": "two"})
+    assert ns.a == 1 and ns.b == "two"
+    assert "a" in ns and "missing" not in ns
+    assert dict(ns.items()) == {"a": 1, "b": "two"}
+
+
+def test_namespace_from_argv_and_namespace():
+    ns = Namespace(["--epochs", "3"])
+    assert ns.argv == ["--epochs", "3"]
+    ns2 = Namespace(Namespace({"x": 9}))
+    assert ns2.x == 9
+    ns3 = Namespace(argparse.Namespace(y=7))
+    assert ns3.y == 7
+    with pytest.raises(TypeError):
+        Namespace(42)
+
+
+def test_params_merge_args_params():
+    est = TFEstimator(lambda a, c: None, {"batch_size": 17, "custom": "keep"})
+    est.setBatchSize(64).setEpochs(5)
+    args = est.merge_args_params()
+    assert args.batch_size == 64      # param wins over arg
+    assert args.epochs == 5
+    assert args.custom == "keep"      # untouched user arg survives
+    assert args.cluster_size == 1     # defaults fill in
+
+
+def test_param_setters_getters_and_copy():
+    m = TFModel({})
+    m.setBatchSize("32")              # converter coerces strings
+    assert m.getBatchSize() == 32
+    m.setInputMapping({"x": "features"})
+    assert m.getInputMapping() == {"x": "features"}
+    with pytest.raises(TypeError):
+        m.setInputMapping("not-a-dict")
+    dup = m.copy()
+    dup.setBatchSize(8)
+    assert m.getBatchSize() == 32 and dup.getBatchSize() == 8
+
+
+def test_copy_accepts_string_keys():
+    est = TFEstimator(lambda a, c: None, {})
+    dup = est.copy({"epochs": 7, "batch_size": "16"})
+    assert dup.getEpochs() == 7
+    assert dup.getBatchSize() == 16  # converter still applies
+    assert est.getEpochs() == 1      # original untouched
+
+
+def test_select_columns_rejects_unprojectable_rows():
+    from tensorflowonspark_tpu.engine import LocalEngine
+    from tensorflowonspark_tpu.pipeline import _select_columns
+
+    engine = LocalEngine(1)
+    try:
+        ds = engine.parallelize([("only", "two")], 1)
+        with pytest.raises(Exception) as e:
+            _select_columns(ds, ["a", "b", "c"]).collect()
+        assert "cannot project" in str(e.value)
+        # matching arity passes through
+        ok = _select_columns(engine.parallelize([(1, 2)], 1), ["a", "b"]).collect()
+        assert ok == [(1, 2)]
+    finally:
+        engine.stop()
+
+
+def test_yield_batch():
+    batches = list(yield_batch(iter(range(10)), 4))
+    assert [len(b) for b in batches] == [4, 4, 2]
+
+
+# -- end-to-end fit -> transform --------------------------------------------
+
+def linreg_main(args, ctx):
+    """User main: trains y = w.x + b from the DataFeed, chief exports."""
+    import jax
+    import optax
+
+    from tensorflowonspark_tpu.models import linear
+    from tensorflowonspark_tpu.utils import checkpoint as ckpt
+
+    feed = ctx.get_data_feed(train_mode=True, input_mapping=args.input_mapping)
+    params = linear.init_params()
+    opt = optax.sgd(0.5)
+    opt_state = opt.init(params)
+    step = jax.jit(linear.make_train_step(opt))
+
+    while not feed.should_stop():
+        batch = feed.next_batch(args.batch_size)
+        if not batch["features"]:
+            continue
+        x = np.asarray(batch["features"], dtype=np.float32)
+        y = np.asarray(batch["label"], dtype=np.float32)
+        params, opt_state, loss = step(params, opt_state, x, y)
+
+    ckpt.export_model(
+        args.export_dir,
+        params,
+        ctx,
+        metadata={"predict": "tensorflowonspark_tpu.models.linear:predict"},
+    )
+
+
+@pytest.mark.slow
+def test_estimator_fit_model_transform(tmp_path):
+    from tensorflowonspark_tpu.engine import LocalEngine
+
+    engine = LocalEngine(
+        2,
+        env={
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": "",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        },
+    )
+    try:
+        rng = np.random.default_rng(42)
+        x = rng.random((1024, 2)).astype(np.float32)
+        y = x @ np.array([W1, W2], dtype=np.float32)
+        rows = [{"x": list(map(float, xi)), "y": float(yi)} for xi, yi in zip(x, y)]
+        ds = engine.parallelize(rows, 4)
+
+        export_dir = str(tmp_path / "export")
+        est = (
+            TFEstimator(linreg_main, {})
+            .setInputMapping({"x": "features", "y": "label"})
+            .setClusterSize(2)
+            .setMasterNode("chief")
+            .setEpochs(12)
+            .setBatchSize(32)
+            .setExportDir(export_dir)
+            .setGraceSecs(5)
+        )
+        model = est.fit(ds)
+        assert isinstance(model, TFModel)
+
+        preds_ds = (
+            model.copy()
+            .setInputMapping({"x": "features"})
+            .setOutputMapping({"prediction": "preds"})
+            .setBatchSize(16)
+            .transform(engine.parallelize([{"x": [1.0, 1.0]}] * 8, 2))
+        )
+        preds = preds_ds.collect()
+        assert len(preds) == 8
+        expected = W1 + W2
+        for row in preds:
+            assert round(float(row["preds"]), 2) == round(expected, 2), preds
+    finally:
+        engine.stop()
