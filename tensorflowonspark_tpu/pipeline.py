@@ -84,6 +84,7 @@ class Params:
     def __init__(self):
         self._paramMap = {}
         self._defaultParamMap = {}
+        self._init_mixin_defaults()
 
     @property
     def params(self):
@@ -137,19 +138,29 @@ class Params:
             dup._set(**{key.name if isinstance(key, Param) else key: value})
         return dup
 
+    def _init_mixin_defaults(self):
+        """Install the default of every Has* mixin in this class's MRO.
 
-def _mixin(name, doc, converter, default=None, _sentinel=object()):
+        Called from Params.__init__ (the single init path — mixins define
+        no __init__ of their own), so user subclasses with custom
+        __init__ signatures are never re-invoked reflectively.
+        """
+        for klass in type(self).__mro__:
+            pname = vars(klass).get("_mixin_param")
+            if pname is not None:
+                self._setDefault(**{pname: vars(klass)["_mixin_default"]})
+
+
+def _mixin(name, doc, converter, default=None):
     """Build a Has<name> mixin with Param + setter/getter, mirroring the
-    reference's ~20 hand-written mixins (pipeline.py:49-293)."""
+    reference's ~20 hand-written mixins (pipeline.py:49-293).  Params
+    declared without an explicit default get a default of None (unlike
+    pyspark, getOrDefault on a fresh instance returns None, not raise)."""
 
     def snake_to_camel(s):
         return "".join(w.capitalize() for w in s.split("_"))
 
     param = Param(name, doc, converter)
-
-    def _init(self):
-        Params.__init__(self) if not hasattr(self, "_paramMap") else None
-        self._setDefault(**{name: default})
 
     def _setter(self, value):
         return self._set(**{name: value})
@@ -162,7 +173,8 @@ def _mixin(name, doc, converter, default=None, _sentinel=object()):
         (Params,),
         {
             name: param,
-            "__init__": _init,
+            "_mixin_param": name,
+            "_mixin_default": default,
             f"set{snake_to_camel(name)}": _setter,
             f"get{snake_to_camel(name)}": _getter,
         },
@@ -315,11 +327,6 @@ class TFEstimator(
         self.train_fn = train_fn
         self.export_fn = export_fn
         self.args = Namespace(tf_args if tf_args is not None else {})
-        for klass in type(self).__mro__:
-            if klass not in (TFEstimator, Params, TFParams, object):
-                init = vars(klass).get("__init__")
-                if init is not None:
-                    init(self)
 
     def fit(self, dataset, params=None):
         if params:
@@ -410,11 +417,6 @@ class TFModel(
     def __init__(self, tf_args=None):
         Params.__init__(self)
         self.args = Namespace(tf_args if tf_args is not None else {})
-        for klass in type(self).__mro__:
-            if klass not in (TFModel, Params, TFParams, object):
-                init = vars(klass).get("__init__")
-                if init is not None:
-                    init(self)
 
     def transform(self, dataset, params=None):
         if params:
@@ -424,7 +426,7 @@ class TFModel(
             "TFModel requires export_dir or model_dir"
         )
         logger.info("transform: args=%s", args)
-        _, ds = _dataset_and_engine(dataset, need_engine=False)
+        ds = _as_dataset(dataset)
 
         input_cols = sorted(args.input_mapping) if args.input_mapping else None
         if input_cols is not None:
@@ -439,6 +441,14 @@ def _run_model(args):
     def _predict_partition(iterator):
         import numpy as np
 
+        # Resolve the cache through the imported module, NOT the closure:
+        # cloudpickle ships this nested function by value with a *copied*
+        # globals dict, so a closed-over _model_cache would be a fresh dict
+        # in every deserialized task.  The worker's module singleton is the
+        # only cache shared across partitions (parity: pipeline.py:492-496,
+        # where _run_model is a top-level function pickled by reference).
+        from tensorflowonspark_tpu import pipeline as _pipeline
+
         input_tensors = (
             [v for _, v in sorted(args.input_mapping.items())]
             if getattr(args, "input_mapping", None) else None
@@ -450,10 +460,10 @@ def _run_model(args):
 
         export_dir = getattr(args, "export_dir", None) or args.model_dir
         key = (export_dir, getattr(args, "signature_def_key", None))
-        if key not in _model_cache:
-            _model_cache[key] = _load_predictor(export_dir, args)
+        if key not in _pipeline._model_cache:
+            _pipeline._model_cache[key] = _pipeline._load_predictor(export_dir, args)
             logger.info("loaded model %s into worker cache", key)
-        predict, params = _model_cache[key]
+        predict, params = _pipeline._model_cache[key]
 
         results = []
         for batch in yield_batch(iterator, args.batch_size):
@@ -534,26 +544,32 @@ def yield_batch(iterator, batch_size):
 # dataset plumbing
 # ---------------------------------------------------------------------------
 
-def _dataset_and_engine(dataset, need_engine=True):
-    """Accept a framework Dataset, (engine, rows) pair, or a Spark
-    DataFrame; return (engine, Dataset)."""
-    from tensorflowonspark_tpu.engine import LocalDataset, SparkDataset, SparkEngine
+def _as_dataset(dataset):
+    """Accept a framework Dataset, (engine, rows) pair, Spark DataFrame,
+    or RDD; return just the Dataset (no engine construction)."""
+    from tensorflowonspark_tpu.engine import as_dataset
+
+    if isinstance(dataset, tuple) and len(dataset) == 2:
+        engine, rows = dataset
+        return engine.parallelize(rows) if isinstance(rows, list) else rows
+    cls = type(dataset)
+    if cls.__module__.startswith("pyspark.sql") and cls.__name__ == "DataFrame":
+        dataset = dataset.rdd
+    return as_dataset(dataset)
+
+
+def _dataset_and_engine(dataset):
+    """Like _as_dataset, but also build the engine that owns the dataset
+    (fit needs it to launch the cluster)."""
+    from tensorflowonspark_tpu.engine import LocalDataset, SparkEngine
 
     if isinstance(dataset, tuple) and len(dataset) == 2:
         engine, rows = dataset
         return engine, engine.parallelize(rows) if isinstance(rows, list) else rows
-    if isinstance(dataset, LocalDataset):
-        return dataset._engine, dataset
-    if isinstance(dataset, SparkDataset):
-        ctx = dataset.rdd.context
-        return SparkEngine(ctx), dataset
-    cls = type(dataset)
-    if cls.__module__.startswith("pyspark.sql") and cls.__name__ == "DataFrame":
-        rdd = dataset.rdd
-        return SparkEngine(rdd.context), SparkDataset(rdd)
-    if cls.__module__.startswith("pyspark") and cls.__name__ == "RDD":
-        return SparkEngine(dataset.context), SparkDataset(dataset)
-    raise TypeError(f"unsupported dataset type: {cls}")
+    ds = _as_dataset(dataset)
+    if isinstance(ds, LocalDataset):
+        return ds._engine, ds
+    return SparkEngine(ds.rdd.context), ds
 
 
 def _select_columns(ds, cols):

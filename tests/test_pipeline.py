@@ -94,6 +94,70 @@ def test_yield_batch():
     assert [len(b) for b in batches] == [4, 4, 2]
 
 
+def test_estimator_and_model_are_subclassable():
+    """Subclasses with custom __init__ signatures must work; the base
+    __init__ installs mixin defaults without reflectively re-invoking
+    every MRO __init__ (regression: MRO loop crashed subclasses)."""
+
+    class MyEstimator(TFEstimator):
+        def __init__(self, fn):
+            super().__init__(fn)
+            self.extra = "yes"
+
+    class MyModel(TFModel):
+        def __init__(self):
+            super().__init__({})
+
+    est = MyEstimator(lambda a, c: None)
+    assert est.extra == "yes"
+    assert est.getBatchSize() == 128
+    model = MyModel()
+    assert model.getBatchSize() == 128
+
+
+def test_model_cache_shared_across_pickled_closures(tmp_path, monkeypatch):
+    """The partition closure must hit the module-level _model_cache, not a
+    cloudpickle-copied closure global (regression: cache never shared)."""
+    import cloudpickle
+
+    from tensorflowonspark_tpu import pipeline as pl
+    from tensorflowonspark_tpu.models import linear
+    from tensorflowonspark_tpu.utils import checkpoint as ckpt
+
+    export_dir = str(tmp_path / "export")
+    ckpt.export_model(
+        export_dir,
+        linear.init_params(),
+        None,
+        metadata={"predict": "tensorflowonspark_tpu.models.linear:predict"},
+    )
+
+    args = Namespace({
+        "export_dir": export_dir,
+        "model_dir": None,
+        "batch_size": 4,
+        "input_mapping": {"x": "features"},
+        "output_mapping": {"prediction": "preds"},
+        "signature_def_key": None,
+    })
+    pl._model_cache.clear()
+    loads = []
+    real_load = pl._load_predictor
+    monkeypatch.setattr(
+        pl, "_load_predictor",
+        lambda d, a: loads.append(d) or real_load(d, a),
+    )
+
+    rows = [([1.0, 1.0],)] * 4
+    # two independently deserialized tasks, as the engine would produce
+    for _ in range(2):
+        closure = cloudpickle.loads(cloudpickle.dumps(pl._run_model(args)))
+        out = closure(iter(rows))
+        assert len(out) == 4
+    assert len(loads) == 1, "model must load once per worker, not per task"
+    assert len(pl._model_cache) == 1
+
+
 # -- end-to-end fit -> transform --------------------------------------------
 
 def linreg_main(args, ctx):
