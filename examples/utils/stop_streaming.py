@@ -1,0 +1,30 @@
+"""Gracefully stop a streaming cluster from outside the driver.
+
+Sends STOP to the cluster's rendezvous server, which makes the driver's
+``train_stream`` loop end after the in-flight micro-batch (parity:
+reference examples/utils/stop_streaming.py:16, which uses
+reservation.Client the same way).
+
+Usage:
+    python stop_streaming.py <host> <port>
+"""
+
+import argparse
+
+from tensorflowonspark_tpu import rendezvous
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("host", help="rendezvous server host")
+    parser.add_argument("port", type=int, help="rendezvous server port")
+    ns = parser.parse_args()
+
+    client = rendezvous.Client((ns.host, ns.port))
+    client.request_stop()
+    client.close()
+    print(f"sent STOP to {ns.host}:{ns.port}")
+
+
+if __name__ == "__main__":
+    main()

@@ -41,10 +41,13 @@ def configure_logging(level=logging.INFO):
 
 _LAZY = {
     "InputMode": ("tensorflowonspark_tpu.cluster", "InputMode"),
-    "TFCluster": ("tensorflowonspark_tpu.cluster", "TFCluster"),
+    # the reference exposes TFCluster as a MODULE (TFCluster.run(...)):
+    # keep that exact import surface
+    "TFCluster": ("tensorflowonspark_tpu.cluster", None),
     "TFNode": ("tensorflowonspark_tpu.feed", None),
     "TFNodeContext": ("tensorflowonspark_tpu.node", "TFNodeContext"),
     "TFParallel": ("tensorflowonspark_tpu.parallel_run", None),
+    "compat": ("tensorflowonspark_tpu.compat", None),
     "dfutil": ("tensorflowonspark_tpu.dfutil", None),
     "pipeline": ("tensorflowonspark_tpu.pipeline", None),
 }

@@ -1,0 +1,46 @@
+"""Compatibility shims (parity: reference tensorflowonspark/compat.py:10-31).
+
+The reference smooths TF1/TF2 API differences; here the shims keep the
+reference's *call sites* working over the TPU-native substrate:
+
+- ``export_saved_model(model, export_dir, ctx)``: chief-only export.  The
+  reference has non-chief workers export to a dummy path (compat.py:12-17,
+  a MultiWorkerMirroredStrategy quirk); TPU-native export simply no-ops on
+  non-chief nodes (utils/checkpoint.py behavior) — no dummy dirs to clean.
+- ``disable_auto_shard(options)``: accepted and ignored.  Auto-sharding is
+  a tf.data concept; the framework's feed already delivers each node its
+  own partitions, and direct-read pipelines shard by process index.
+- ``is_gpu_available()``: truthful accelerator check for the hardware this
+  framework targets (TPU chips), name kept for drop-in compatibility.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from tensorflowonspark_tpu import tpu_info
+from tensorflowonspark_tpu.utils import checkpoint as _checkpoint
+
+logger = logging.getLogger(__name__)
+
+
+def export_saved_model(model, export_dir, ctx=None, metadata=None):
+    """Export ``model`` (a params pytree, or an object with a ``params``
+    attribute) from the chief only (compat.py:10-17 parity)."""
+    params = getattr(model, "params", model)
+    return _checkpoint.export_model(export_dir, params, ctx, metadata=metadata)
+
+
+def disable_auto_shard(options):
+    """No-op (compat.py:20-24): partition feeds are already per-node."""
+    logger.debug("disable_auto_shard: no-op on the TPU-native feed")
+    return options
+
+
+def is_gpu_available():
+    """Accelerator availability (compat.py:27-31); checks TPU chips."""
+    return tpu_info.is_tpu_available()
+
+
+# honest alias for new code
+is_tpu_available = tpu_info.is_tpu_available

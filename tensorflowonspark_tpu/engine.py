@@ -146,13 +146,16 @@ class LocalDataset:
         self._engine._run_job(tasks, collect=False, spread=spread,
                               placement=placement)
 
-    def collect(self):
+    def collect(self, spread=False):
+        """Materialize all partitions.  ``spread=True`` pins task i to
+        executor i (one concurrent task per slot — the barrier-execution
+        guarantee TFParallel-style jobs need)."""
         tasks = [
             (items, chain if chain is not None else (lambda it: list(it)))
             for items, chain in self._tasks()
         ]
         parts = self._engine._run_job(
-            tasks, collect=True, spread=False, placement=None
+            tasks, collect=True, spread=spread, placement=None
         )
         out = []
         for p in parts:
@@ -384,7 +387,12 @@ class SparkDataset:
     def foreach_partition(self, fn, spread=False, placement=None):
         self.rdd.foreachPartition(fn)
 
-    def collect(self):
+    def collect(self, spread=False):
+        if spread:
+            logger.warning(
+                "collect(spread=True) is a no-op on Spark; use "
+                "rdd.barrier() for one-task-per-slot scheduling"
+            )
         return self.rdd.collect()
 
     def union(self, *others):

@@ -1,0 +1,129 @@
+"""Embarrassingly-parallel mode: N independent single-node jobs
+(parity: reference tensorflowonspark/TFParallel.py:17-64).
+
+No cluster is formed — no rendezvous, no coordinator, no collectives.
+Each engine executor runs ``map_fn(tf_args, ctx)`` against its own local
+accelerators, the pattern for batch inference over many hosts
+(reference examples/mnist/keras/mnist_inference.py:79).
+
+The reference uses Spark *barrier execution* so every task starts
+together and can see its peers' addresses (``BarrierTaskContext
+.getTaskInfos()``, TFParallel.py:43-45); peer visibility feeds the
+same-host worker index used to partition GPUs among co-hosted executors
+(util.single_node_env, TFParallel.py:49).  Here the same placement logic
+partitions *TPU chips* (tpu_info.set_visible_chips) — each co-hosted
+process gets a disjoint chip block before its JAX runtime initializes.
+
+Unlike the reference (which returns None), ``run`` returns the collected
+``map_fn`` results, one per worker.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from tensorflowonspark_tpu import engine as engine_mod
+from tensorflowonspark_tpu.utils import get_ip_address, single_node_env
+
+logger = logging.getLogger(__name__)
+
+
+def _barrier_placement(executor_id, num_workers):
+    """(peer_hosts, same_host_index, worker_num) for this task.
+
+    Inside a Spark barrier task, peers come from
+    ``BarrierTaskContext.getTaskInfos()`` (TFParallel.py:43-45).  On the
+    built-in engine every executor is a co-hosted process, so the
+    executor index doubles as the same-host index.
+    """
+    try:
+        from pyspark import BarrierTaskContext
+
+        tc = BarrierTaskContext.get()
+        if tc is not None:
+            addrs = [info.address.split(":")[0] for info in tc.getTaskInfos()]
+            worker_num = tc.partitionId()
+            same_host = sum(1 for a in addrs[:worker_num] if a == addrs[worker_num])
+            return addrs, same_host, worker_num
+    except Exception:  # noqa: BLE001 - not a barrier task / no pyspark
+        pass
+    # LocalEngine path: every executor IS a co-hosted process of this host,
+    # so the executor index doubles as the same-host index.  (Spark tasks
+    # never reach here — the Spark path always runs under a barrier.)
+    idx = int(os.environ.get("TFOS_EXECUTOR_INDEX", executor_id))
+    return [get_ip_address()] * num_workers, idx, executor_id
+
+
+def _make_closure(map_fn, tf_args, meta, num_workers):
+    def _run(iterator):
+        from tensorflowonspark_tpu.node import TFNodeContext
+
+        executor_id = 0
+        for item in iterator:  # one id per spread partition
+            executor_id = item
+
+        peers, same_host_index, worker_num = _barrier_placement(
+            executor_id, num_workers
+        )
+        single_node_env(meta["num_chips"], same_host_index)
+
+        cluster_info = [
+            {
+                "executor_id": i,
+                "host": h,
+                "job_name": "worker",
+                "task_index": i,
+                "port": None,
+            }
+            for i, h in enumerate(peers)
+        ]
+        ctx = TFNodeContext(
+            executor_id=worker_num,
+            job_name="worker",
+            task_index=worker_num,
+            cluster_spec={"worker": cluster_info},
+            default_fs=meta["default_fs"],
+            working_dir=meta["working_dir"],
+            mgr=None,
+            cluster_info=cluster_info,
+        )
+        logger.info("parallel worker %d/%d starting", worker_num, num_workers)
+        return [map_fn(tf_args, ctx)]
+
+    return _run
+
+
+def run(sc, map_fn, tf_args, num_executors=None, num_chips=0):
+    """Run ``map_fn(tf_args, ctx)`` as N independent single-node jobs.
+
+    ``sc`` is a SparkContext or LocalEngine (anything ``as_engine``
+    accepts).  Returns the list of per-worker results.
+    """
+    eng = engine_mod.as_engine(sc)
+    n = int(num_executors or eng.num_executors)
+    meta = {
+        "default_fs": eng.default_fs,
+        "working_dir": os.getcwd(),
+        "num_chips": num_chips,
+    }
+    closure = _make_closure(map_fn, tf_args, meta, n)
+
+    if isinstance(eng, engine_mod.SparkEngine):
+        # Barrier-only, like the reference (TFParallel.py:63): if the
+        # cluster cannot schedule all n tasks together, the job should
+        # fail loudly rather than run workers serially.
+        rdd = eng.sc.parallelize(range(n), n)
+        return rdd.barrier().mapPartitions(closure).collect()
+
+    # Built-in engine: spread pins one task per executor, which is the
+    # barrier guarantee the reference needs (concurrent, one per slot).
+    # More tasks than slots would serialize behind each other (and claim
+    # overlapping chip blocks), silently breaking that guarantee.
+    if n > eng.num_executors:
+        raise ValueError(
+            f"parallel run of {n} workers requires {n} executors; "
+            f"engine has {eng.num_executors}"
+        )
+    ds = eng.parallelize(range(n), n).map_partitions(closure)
+    return ds.collect(spread=True)

@@ -55,6 +55,21 @@ def _terminate_fn(args, ctx):
     feed.terminate()
 
 
+def _stream_consumer_fn(args, ctx):
+    # online-training consumer: terminate after enough records arrive
+    # (parity: the streaming examples' StopFeedHook behavior)
+    feed = ctx.get_data_feed(train_mode=True)
+    total = 0
+    while not feed.should_stop():
+        batch = feed.next_batch(50)
+        total += len(batch)
+        if total >= 200:
+            feed.terminate()
+            break
+    with open("stream_total", "w") as f:
+        f.write(str(total))
+
+
 # --- tests ------------------------------------------------------------------
 
 def test_independent_nodes(engine):
@@ -115,4 +130,51 @@ def test_datafeed_terminate_requests_stop(engine):
     ds = engine.parallelize(range(2000), 2)
     cluster.train(ds)
     assert cluster.server.done.wait(15)
+    cluster.shutdown()
+
+
+def test_train_stream_feeds_until_stop(engine):
+    """Streaming micro-batches stop gracefully when a consumer terminates
+    (parity: DStream feeding + stop_streaming, TFCluster.py:83-85,146-153)."""
+    cluster = TFCluster.run(
+        engine, _stream_consumer_fn, [], num_executors=2,
+        input_mode=InputMode.SPARK,
+    )
+
+    def micro_batches():
+        for _ in range(200):  # long but finite: a hang fails the test, not CI
+            yield engine.parallelize(range(100), 2)
+
+    cluster.train_stream(micro_batches(), feed_timeout=30)
+    assert cluster.server.done.is_set(), "stream should end via STOP"
+    cluster.shutdown()
+    totals = (
+        engine.parallelize(range(2), 2)
+        .map_partitions(lambda it: [int(open("stream_total").read())])
+        .collect(spread=True)  # pin task i to executor i's working dir
+    )
+    # at least the terminating consumer saw its 200 records
+    assert max(totals) >= 200, totals
+
+
+def test_stop_streaming_utility(engine):
+    """Driver-external STOP via the rendezvous address (parity:
+    examples/utils/stop_streaming.py)."""
+    from tensorflowonspark_tpu import rendezvous
+
+    cluster = TFCluster.run(
+        engine, _stream_consumer_fn, [], num_executors=2,
+        input_mode=InputMode.SPARK,
+    )
+    host, port = cluster.cluster_meta["server_addr"]
+    client = rendezvous.Client((host, port))
+    client.request_stop()
+    client.close()
+    assert cluster.server.done.wait(15)
+
+    def micro_batches():
+        while True:  # never consumed: STOP already set
+            yield engine.parallelize(range(10), 2)
+
+    cluster.train_stream(micro_batches())  # returns immediately
     cluster.shutdown()

@@ -1,0 +1,58 @@
+"""Compat-shim tests (reference surface: compat.py:10-31)."""
+
+import os
+
+import numpy as np
+
+from tensorflowonspark_tpu import compat
+from tensorflowonspark_tpu.node import TFNodeContext
+from tensorflowonspark_tpu.utils.checkpoint import load_exported
+
+
+def _ctx(job_name, task_index=0):
+    return TFNodeContext(
+        executor_id=task_index,
+        job_name=job_name,
+        task_index=task_index,
+        cluster_spec={},
+        default_fs="file://",
+        working_dir="/tmp",
+        mgr=None,
+    )
+
+
+def test_export_saved_model_chief_only(tmp_path):
+    params = {"w": np.ones((2, 2), np.float32)}
+    chief_dir = str(tmp_path / "chief")
+    worker_dir = str(tmp_path / "worker")
+
+    assert compat.export_saved_model(params, chief_dir, _ctx("chief")) == chief_dir
+    assert os.path.exists(os.path.join(chief_dir, "params.npz"))
+
+    # non-chief: no export, and no dummy dir either (unlike the reference)
+    assert compat.export_saved_model(params, worker_dir, _ctx("worker", 1)) is None
+    assert not os.path.exists(worker_dir)
+
+    loaded, _meta = load_exported(chief_dir)
+    np.testing.assert_array_equal(loaded["w"], params["w"])
+
+
+def test_export_saved_model_unwraps_model_objects(tmp_path):
+    class Model:
+        params = {"b": np.zeros(3, np.float32)}
+
+    out = compat.export_saved_model(Model(), str(tmp_path / "m"))
+    loaded, _ = load_exported(out)
+    np.testing.assert_array_equal(loaded["b"], np.zeros(3))
+
+
+def test_disable_auto_shard_is_passthrough():
+    sentinel = object()
+    assert compat.disable_auto_shard(sentinel) is sentinel
+
+
+def test_is_gpu_available_reflects_chip_count(monkeypatch):
+    monkeypatch.setenv("TFOS_TPU_CHIPS_PER_HOST", "4")
+    assert compat.is_gpu_available() is True
+    monkeypatch.setenv("TFOS_TPU_CHIPS_PER_HOST", "0")
+    assert compat.is_gpu_available() is False
