@@ -1,0 +1,90 @@
+"""ops/: flash attention + fused rmsnorm vs reference implementations.
+
+Pallas kernels run in interpret mode on the CPU test platform; the same
+code path compiles on TPU.  Mirrors the reference's exhaustive
+marshalling-matrix style (SURVEY.md §4 takeaway d) over shapes/flags.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import ops
+
+
+def _qkv(key, b, s, h, d, dtype=jnp.float32):
+    ks = jax.random.split(key, 3)
+    mk = lambda k: jax.random.normal(k, (b, s, h, d), dtype)
+    return mk(ks[0]), mk(ks[1]), mk(ks[2])
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("seq", [64, 96])  # 96: tail-masking path (not % 64)
+def test_flash_matches_reference(causal, seq):
+    q, k, v = _qkv(jax.random.PRNGKey(0), 2, seq, 2, 16)
+    ref = ops.mha_reference(q, k, v, causal=causal)
+    out = ops.flash_attention(q, k, v, causal=causal, block_q=64, block_kv=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_grad_matches_reference():
+    q, k, v = _qkv(jax.random.PRNGKey(1), 1, 32, 2, 8)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(ops.flash_attention(q, k, v, causal=True,
+                                           block_q=16, block_kv=16) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(ops.mha_reference(q, k, v, causal=True) ** 2)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_flash_under_jit_bf16():
+    q, k, v = _qkv(jax.random.PRNGKey(2), 1, 64, 4, 16, dtype=jnp.bfloat16)
+    out = jax.jit(
+        lambda q, k, v: ops.flash_attention(q, k, v, causal=True)
+    )(q, k, v)
+    ref = ops.mha_reference(q, k, v, causal=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2
+    )
+
+
+def test_rope_roundtrip_and_offset():
+    cos, sin = ops.rope_angles(128, 16)
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 8, 2, 16))
+    # positions arg with explicit offsets == slicing the table
+    pos = jnp.broadcast_to(jnp.arange(8) + 32, (2, 8))
+    a = ops.apply_rope(x, cos, sin, positions=pos)
+    b = ops.apply_rope(
+        jnp.asarray(x), cos[32:40], sin[32:40]
+    )
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    # norm preservation (rotations)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(a), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1),
+        atol=1e-4,
+    )
+
+
+def test_fused_rmsnorm_matches_reference_and_grads():
+    x = jax.random.normal(jax.random.PRNGKey(4), (3, 17, 64))
+    scale = jax.random.normal(jax.random.PRNGKey(5), (64,)) + 1.0
+    np.testing.assert_allclose(
+        np.asarray(ops.fused_rmsnorm(x, scale, block_rows=8)),
+        np.asarray(ops.rmsnorm_reference(x, scale)),
+        atol=1e-5,
+    )
+    g1 = jax.grad(lambda x, s: jnp.sum(ops.fused_rmsnorm(x, s) ** 2),
+                  argnums=(0, 1))(x, scale)
+    g2 = jax.grad(lambda x, s: jnp.sum(ops.rmsnorm_reference(x, s) ** 2),
+                  argnums=(0, 1))(x, scale)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
