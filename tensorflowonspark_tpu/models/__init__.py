@@ -1,5 +1,6 @@
 """Model zoo: the workloads the reference ships as examples (SURVEY.md §2.5)
 re-built as pure-JAX functional models — MNIST CNN, ResNet (CIFAR +
-ImageNet variants), and encoder-decoder segmentation."""
+ImageNet variants), U-Net segmentation — plus the decoder-only
+transformer family (long-context flagship; no reference counterpart)."""
 
 from tensorflowonspark_tpu.models import layers  # noqa: F401

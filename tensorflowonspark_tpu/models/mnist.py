@@ -74,3 +74,17 @@ def synthetic_batch(key, n):
     )
     labels = (jnp.argmax(q, axis=-1) * 2 + (q.sum(-1) > 2.0)).astype(jnp.int32)
     return images, labels
+
+
+def predict(params, inputs):
+    """Export predict signature ({tensor_name: ndarray} -> outputs dict),
+    referenced from export metadata as
+    ``tensorflowonspark_tpu.models.mnist:predict``."""
+    import numpy as np
+
+    (x,) = inputs.values()
+    x = np.asarray(x, dtype=np.float32)
+    if x.ndim == 2:  # flat 784 rows (CSV / TFRecord ingestion)
+        x = x.reshape(-1, 28, 28, 1)
+    logits = np.asarray(apply(params, x))
+    return {"prediction": logits.argmax(-1), "logits": logits}

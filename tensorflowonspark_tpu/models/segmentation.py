@@ -1,0 +1,170 @@
+"""Image segmentation family: MobileNetV2-style encoder + U-Net decoder.
+
+Parity workload: reference examples/segmentation/segmentation*.py (the
+Oxford-IIIT pet U-Net built on a MobileNetV2 encoder with pix2pix-style
+upsample blocks; see SURVEY.md §2.5).  Re-designed functionally like the
+rest of the zoo: inverted-residual bottlenecks (expand 1x1 → depthwise
+3x3 → project 1x1), skip taps after each stride-2 stage, and a
+transposed-conv decoder that concatenates the taps U-Net style.
+
+TPU-first notes: NHWC/HWIO; depthwise convs via feature_group_count
+(XLA lowers these onto the VPU/MXU efficiently); params fp32 with bf16
+compute supported via the input dtype like models/resnet.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+import optax
+
+from tensorflowonspark_tpu.models import layers as L
+
+
+def _dwconv_init(key, ch, dtype=jnp.float32):
+    # depthwise 3x3: HWIO with I=1, O=ch, feature_group_count=ch
+    return {"w": L._he_init(key, (3, 3, 1, ch), 9, dtype)}
+
+
+def _dwconv(params, x, stride=1):
+    return lax.conv_general_dilated(
+        x,
+        params["w"].astype(x.dtype),
+        window_strides=(stride, stride),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=x.shape[-1],
+    )
+
+
+def _invres_init(key, in_ch, out_ch, expand, dtype):
+    ks = jax.random.split(key, 3)
+    mid = in_ch * expand
+    p, s = {}, {}
+    if expand != 1:
+        p["expand"] = L.conv_init(ks[0], 1, 1, in_ch, mid, dtype, use_bias=False)
+        p["bn_e"], s["bn_e"] = L.batchnorm_init(mid)
+    p["dw"] = _dwconv_init(ks[1], mid, dtype)
+    p["bn_d"], s["bn_d"] = L.batchnorm_init(mid)
+    p["project"] = L.conv_init(ks[2], 1, 1, mid, out_ch, dtype, use_bias=False)
+    p["bn_p"], s["bn_p"] = L.batchnorm_init(out_ch)
+    return p, s
+
+
+def _invres_apply(p, s, x, stride, train):
+    ns = {}
+    y = x
+    if "expand" in p:
+        y = L.conv(p["expand"], y)
+        y, ns["bn_e"] = L.batchnorm(p["bn_e"], s["bn_e"], y, train)
+        y = jax.nn.relu6(y)
+    y = _dwconv(p["dw"], y, stride=stride)
+    y, ns["bn_d"] = L.batchnorm(p["bn_d"], s["bn_d"], y, train)
+    y = jax.nn.relu6(y)
+    y = L.conv(p["project"], y)
+    y, ns["bn_p"] = L.batchnorm(p["bn_p"], s["bn_p"], y, train)
+    if stride == 1 and x.shape[-1] == y.shape[-1]:
+        y = x + y
+    return y, ns
+
+
+# encoder stage plan: (out_ch, stride, expand) — a compact MobileNetV2;
+# each stride-2 output (pre-stride feature) is a U-Net skip tap.
+_ENCODER = [
+    (16, 1, 1),
+    (24, 2, 6),
+    (32, 2, 6),
+    (64, 2, 6),
+    (96, 1, 6),
+]
+
+
+def _upconv_init(key, in_ch, out_ch, dtype):
+    # 3x3 stride-2 transposed conv (pix2pix upsample block sans dropout)
+    return {"w": L._he_init(key, (3, 3, in_ch, out_ch), 9 * in_ch, dtype)}
+
+
+def _upconv(params, x):
+    return lax.conv_transpose(
+        x,
+        params["w"].astype(x.dtype),
+        strides=(2, 2),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def init(key, num_classes=3, in_ch=3, width=1.0, dtype=jnp.float32):
+    """(params, state) for a U-Net over the MobileNetV2-style encoder."""
+    ks = iter(jax.random.split(key, 64))
+    p, s = {}, {}
+    ch = max(8, int(16 * width))
+    p["stem"] = L.conv_init(next(ks), 3, 3, in_ch, ch, dtype, use_bias=False)
+    p["bn_stem"], s["bn_stem"] = L.batchnorm_init(ch)
+
+    taps = []
+    for i, (out_ch, stride, expand) in enumerate(_ENCODER):
+        out_ch = max(8, int(out_ch * width))
+        if stride == 2:
+            taps.append(ch)
+        p[f"enc{i}"], s[f"enc{i}"] = _invres_init(next(ks), ch, out_ch, expand, dtype)
+        ch = out_ch
+
+    for i, skip_ch in enumerate(reversed(taps)):
+        p[f"up{i}"] = _upconv_init(next(ks), ch, skip_ch, dtype)
+        p[f"bn_up{i}"], s[f"bn_up{i}"] = L.batchnorm_init(skip_ch)
+        ch = skip_ch * 2  # concat with the tap
+    p["head"] = _upconv_init(next(ks), ch, num_classes, dtype)
+    return p, s
+
+
+def apply(params, state, x, train=False):
+    """[B, H, W, C] -> ([B, H, W, num_classes] logits, new_state).
+    H and W must be divisible by 2**(#stride-2 stages + stem)."""
+    ns = {}
+    y = L.conv(params["stem"], x, stride=2)
+    y, ns["bn_stem"] = L.batchnorm(params["bn_stem"], state["bn_stem"], y, train)
+    y = jax.nn.relu6(y)
+
+    taps = []
+    for i, (_, stride, _) in enumerate(_ENCODER):
+        if stride == 2:
+            taps.append(y)
+        y, ns[f"enc{i}"] = _invres_apply(
+            params[f"enc{i}"], state[f"enc{i}"], y, stride, train
+        )
+
+    for i, tap in enumerate(reversed(taps)):
+        y = _upconv(params[f"up{i}"], y)
+        y, ns[f"bn_up{i}"] = L.batchnorm(
+            params[f"bn_up{i}"], state[f"bn_up{i}"], y, train
+        )
+        y = L.relu(y)
+        y = jnp.concatenate([y, tap], axis=-1)
+    logits = _upconv(params["head"], y)
+    return logits, ns
+
+
+def loss_fn(params, state, images, masks, train=True):
+    """Per-pixel CE; masks [B, H, W] int. Returns (loss, new_state)."""
+    logits, ns = apply(params, state, images, train=train)
+    loss = L.softmax_cross_entropy(
+        logits.reshape(-1, logits.shape[-1]), masks.reshape(-1)
+    )
+    return loss, ns
+
+
+def make_train_step(opt):
+    """Jittable (params, state, opt_state, images, masks) -> updated + loss.
+    Under a mesh-sharded batch, GSPMD emits the gradient all-reduce."""
+
+    def step(params, state, opt_state, images, masks):
+        (loss, ns), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, state, images, masks
+        )
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, ns, opt_state, loss
+
+    return step

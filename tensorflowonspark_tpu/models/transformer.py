@@ -1,0 +1,170 @@
+"""Decoder-only transformer — the long-context flagship model family.
+
+No counterpart exists in the reference (pre-LLM design, SURVEY.md §5
+"Long-context — absent"); this is the model family that exercises the
+framework's first-class mesh axes: data/fsdp (batch), model (tensor
+parallel, Megatron-style column→row sharded matmul pairs where GSPMD
+inserts the all-reduces), and seq (ring-attention sequence parallelism
+via parallel/ring.py).
+
+TPU-first choices mirror models/resnet.py: params live in float32,
+activations/matmuls run in the config compute dtype (bfloat16 on TPU)
+with f32 accumulation; layers are scanned (one compiled layer body);
+attention is ops.flash_attention (pallas) unless a sequence-parallel
+attn_fn is injected.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tensorflowonspark_tpu import ops
+from tensorflowonspark_tpu.models import layers as L
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    vocab_size: int = 32000
+    dim: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    max_seq: int = 2048
+    mlp_ratio: int = 4
+    rope_base: float = 10000.0
+    dtype: str = "bfloat16"  # compute dtype; params always float32
+    # 'flash' = pallas kernel (single-chip / shard_map contexts only:
+    # GSPMD cannot auto-partition a pallas_call); 'reference' = pure XLA
+    # einsum formulation, partitionable by GSPMD on any mesh.
+    attn_impl: str = "flash"
+
+    @property
+    def head_dim(self):
+        assert self.dim % self.n_heads == 0
+        return self.dim // self.n_heads
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def _layer_init(key, cfg):
+    ks = jax.random.split(key, 6)
+    dim, mlp = cfg.dim, cfg.dim * cfg.mlp_ratio
+    dense = lambda k, i, o: L._he_init(k, (i, o), i, jnp.float32)
+    return {
+        "ln1": jnp.ones((dim,), jnp.float32),
+        "wqkv": dense(ks[0], dim, 3 * dim),
+        "wo": dense(ks[1], dim, dim),
+        "ln2": jnp.ones((dim,), jnp.float32),
+        "w1": dense(ks[2], dim, mlp),
+        "w2": dense(ks[3], mlp, dim),
+    }
+
+
+def init(key, cfg: Config):
+    """Params pytree; per-layer trees stacked on a leading n_layers axis
+    so apply() scans one compiled layer body."""
+    k_embed, k_head, k_layers = jax.random.split(key, 3)
+    layer_keys = jax.random.split(k_layers, cfg.n_layers)
+    layers = jax.vmap(lambda k: _layer_init(k, cfg))(layer_keys)
+    return {
+        "embed": jax.random.normal(
+            k_embed, (cfg.vocab_size, cfg.dim), jnp.float32
+        ) * 0.02,
+        "layers": layers,
+        "ln_f": jnp.ones((cfg.dim,), jnp.float32),
+        "head": L._he_init(k_head, (cfg.dim, cfg.vocab_size), cfg.dim,
+                           jnp.float32),
+    }
+
+
+def param_specs(cfg: Config, *, tp_axis="model", fsdp_axis="fsdp", mesh=None):
+    """Megatron-style PartitionSpecs matching init()'s tree.
+
+    Column-parallel (out-dim on tp): wqkv, w1, head; row-parallel (in-dim
+    on tp): wo, w2 — each column→row pair needs exactly one all-reduce,
+    which GSPMD inserts from these annotations.  Layer trees carry the
+    leading scan axis (None).  Pass ``mesh`` to drop axes the mesh does
+    not define (e.g. a data x seq x model mesh without fsdp).
+    """
+    if mesh is not None:
+        axes = set(mesh.shape)
+        tp_axis = tp_axis if tp_axis in axes else None
+        fsdp_axis = fsdp_axis if fsdp_axis in axes else None
+    col = P(fsdp_axis, tp_axis)
+    row = P(tp_axis, fsdp_axis)
+    lcol = P(None, fsdp_axis, tp_axis)
+    lrow = P(None, tp_axis, fsdp_axis)
+    return {
+        "embed": P(None, fsdp_axis),
+        "layers": {
+            "ln1": P(None, None),
+            "wqkv": lcol,
+            "wo": lrow,
+            "ln2": P(None, None),
+            "w1": lcol,
+            "w2": lrow,
+        },
+        "ln_f": P(None),
+        "head": col,
+    }
+
+
+def _matmul(x, w):
+    return jnp.dot(
+        x, w.astype(x.dtype), preferred_element_type=jnp.float32
+    ).astype(x.dtype)
+
+
+def _layer_apply(p, x, cfg, rope, attn_fn):
+    b, s, dim = x.shape
+    h, hd = cfg.n_heads, cfg.head_dim
+    cos, sin = rope
+
+    y = ops.rmsnorm_reference(x, p["ln1"])
+    qkv = _matmul(y, p["wqkv"]).reshape(b, s, 3, h, hd)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    q = ops.apply_rope(q, cos, sin)
+    k = ops.apply_rope(k, cos, sin)
+    attn = attn_fn(q, k, v).reshape(b, s, dim)
+    x = x + _matmul(attn, p["wo"])
+
+    y = ops.rmsnorm_reference(x, p["ln2"])
+    y = _matmul(jax.nn.gelu(_matmul(y, p["w1"])), p["w2"])
+    return x + y
+
+
+def apply(params, tokens, cfg: Config, *, attn_fn=None):
+    """tokens [B, S] int32 -> logits [B, S, vocab] float32.
+
+    ``attn_fn(q, k, v) -> out`` on [B, S, H, D]; default is causal
+    pallas flash attention.  Pass
+    ``parallel.sequence_parallel_attention(mesh, 'ring', causal=True)``
+    for sequence-parallel long-context runs.
+    """
+    if attn_fn is None:
+        base = (ops.flash_attention if cfg.attn_impl == "flash"
+                else ops.mha_reference)
+        attn_fn = functools.partial(base, causal=True)
+    dtype = cfg.compute_dtype
+    x = params["embed"].astype(dtype)[tokens]
+    rope = ops.rope_angles(tokens.shape[1], cfg.head_dim, cfg.rope_base)
+
+    def body(x, layer_params):
+        return _layer_apply(layer_params, x, cfg, rope, attn_fn), None
+
+    x, _ = lax.scan(body, x, params["layers"])
+    x = ops.rmsnorm_reference(x, params["ln_f"])
+    return _matmul(x, params["head"]).astype(jnp.float32)
+
+
+def loss_fn(params, tokens, cfg: Config, *, attn_fn=None):
+    """Next-token cross entropy (mean over B, S-1)."""
+    logits = apply(params, tokens, cfg, attn_fn=attn_fn)
+    return L.softmax_cross_entropy(logits[:, :-1], tokens[:, 1:])

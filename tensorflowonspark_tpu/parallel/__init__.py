@@ -14,6 +14,11 @@ from tensorflowonspark_tpu.parallel.mesh import (  # noqa: F401
     replicated,
     sharded,
 )
+from tensorflowonspark_tpu.parallel.ring import (  # noqa: F401
+    ring_attention,
+    sequence_parallel_attention,
+    ulysses_attention,
+)
 from tensorflowonspark_tpu.parallel.sharding import (  # noqa: F401
     apply_shardings,
     batch_sharding,

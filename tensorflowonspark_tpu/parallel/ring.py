@@ -1,0 +1,174 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+Long-context support is green-field relative to the reference (SURVEY.md
+§5 "Long-context / sequence parallelism — absent"); here it is
+first-class.  Two interchangeable schemes over a named sequence mesh
+axis:
+
+- **Ring attention** (``ring_attention``): every device keeps its local
+  q shard and rotates the k/v shards around the ring with
+  ``lax.ppermute`` (rides ICI neighbor links), accumulating blockwise
+  online-softmax partials.  Peak memory is O(S_local²) per step and the
+  k/v transfer overlaps the next block's compute under XLA's async
+  collective scheduling.
+- **Ulysses** (``ulysses_attention``): ``lax.all_to_all`` re-shards
+  seq→heads so each device computes *full-sequence* attention for a
+  subset of heads, then re-shards back.  One collective pair instead of
+  ring steps; needs heads % axis_size == 0.
+
+Both are meant to run inside ``shard_map`` (helpers below wrap that) and
+are differentiable — ppermute/all_to_all have transposes, and the
+blockwise softmax is plain traced math.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+try:  # jax>=0.8
+    from jax import shard_map as _shard_map_raw
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map_raw
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, mesh, in_specs, out_specs):
+    """Version-stable shard_map with replication checking off (the ring
+    primitives produce unreplicated outputs from psum-free math, which
+    the checker cannot prove)."""
+    return _shard_map_raw(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **{_CHECK_KW: False},
+    )
+
+
+_NEG_INF = -1e30
+
+
+def _block_scores(q, k, scale, causal, q_offset, kv_offset):
+    """[B,Sq,H,D]x[B,Skv,H,D] -> masked f32 scores [B,H,Sq,Skv]."""
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk",
+        q.astype(jnp.float32),
+        k.astype(jnp.float32),
+    ) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[1])
+        kpos = kv_offset + jnp.arange(k.shape[1])
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    return s
+
+
+def ring_attention(q, k, v, axis_name, *, causal=False, scale=None):
+    """Attention over a sequence-sharded ring; call inside shard_map.
+
+    q/k/v: local shards [B, S_local, H, D]; the global sequence is the
+    concatenation over the ``axis_name`` ring order.  Returns the local
+    output shard [B, S_local, H, D].
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+
+    acc = jnp.zeros((b, h, s_local, d), jnp.float32)
+    m = jnp.full((b, h, s_local), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, s_local), jnp.float32)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    k_cur, v_cur = k, v
+    for step in range(axis_size):
+        # after `step` rotations each device holds the shard originally
+        # at (my_idx - step); step 0 is the local diagonal block, so for
+        # causal masking m is finite after step 0 for every valid row
+        # and fully-masked later blocks contribute exp(-inf - m) = 0.
+        kv_idx = (my_idx - step) % axis_size
+        s = _block_scores(
+            q, k_cur, scale, causal,
+            q_offset=my_idx * s_local, kv_offset=kv_idx * s_local,
+        )
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32)
+        )
+        m = m_new
+        if step + 1 < axis_size:
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
+
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name, *, causal=False, scale=None,
+                      attn_fn=None):
+    """All-to-all sequence parallelism; call inside shard_map.
+
+    Re-shards [B, S/n, H, D] -> [B, S, H/n, D], runs full-sequence
+    attention locally (``attn_fn``, default the XLA reference; pass
+    ops.flash_attention on TPU), and re-shards back.
+    """
+    from tensorflowonspark_tpu.ops import mha_reference
+
+    if attn_fn is None:
+        attn_fn = mha_reference
+    n = lax.psum(1, axis_name)
+    assert q.shape[2] % n == 0, (
+        f"ulysses needs heads ({q.shape[2]}) divisible by axis size ({n})"
+    )
+    # seq-shard -> head-shard: split heads axis, gather seq axis
+    a2a = functools.partial(
+        lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1,
+        tiled=True,
+    )
+    qg, kg, vg = a2a(q), a2a(k), a2a(v)
+    out = attn_fn(qg, kg, vg, causal=causal, scale=scale)
+    # head-shard -> seq-shard
+    return lax.all_to_all(
+        out, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def sequence_parallel_attention(mesh, impl="ring", *, seq_axis="seq",
+                                batch_axes=("data", "fsdp"),
+                                head_axis="model", causal=False, scale=None):
+    """shard_map-wrapped attention over ``mesh``: [B, S, H, D] global
+    arrays, batch sharded over ``batch_axes``, sequence over
+    ``seq_axis``, heads over ``head_axis`` (tp); returns same sharding.
+
+    This is the building block models call when a 'seq' axis is present
+    (models/transformer.py) — dp/fsdp/tp stay GSPMD-managed, only the
+    sequence dimension's cross-shard exchange is explicit.
+    """
+    fns = {"ring": ring_attention, "ulysses": ulysses_attention}
+    inner = functools.partial(
+        fns[impl], axis_name=seq_axis, causal=causal, scale=scale
+    )
+    axes = dict(mesh.shape)
+    batch_axes = tuple(a for a in batch_axes if a in axes)
+    head = head_axis if head_axis in axes else None
+    spec = P(batch_axes if batch_axes else None, seq_axis, head, None)
+
+    def call(q, k, v):
+        return shard_map(
+            lambda q, k, v: inner(q, k, v),
+            mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+        )(q, k, v)
+
+    return call
