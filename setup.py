@@ -17,4 +17,10 @@ setup(
         "tpu": ["jax", "optax", "orbax-checkpoint"],
         "spark": ["pyspark>=3.0"],
     },
+    entry_points={
+        "console_scripts": [
+            # parity: the reference's spark-submit Inference.scala CLI
+            "tfos-inference=tensorflowonspark_tpu.inference:main",
+        ],
+    },
 )

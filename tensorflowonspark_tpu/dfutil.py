@@ -110,12 +110,15 @@ def save_as_tfrecords(dataset_or_rows, output_dir):
 
     def write_partition(it):
         import os as _os
+        import uuid as _uuid
 
         rows = list(it)
         if not rows:
             return []
+        # unique per partition even when one executor writes several
+        # shards back to back (id()-based names can repeat after reuse)
         shard = _os.path.join(
-            output_dir, f"part-r-{_os.getpid()}-{id(rows) & 0xffff:05d}"
+            output_dir, f"part-r-{_os.getpid()}-{_uuid.uuid4().hex[:8]}"
         )
         _write_shard(rows, shard)
         return [shard]

@@ -1,0 +1,139 @@
+"""Batch-inference CLI (parity: src/main/scala Inference.scala:27-79).
+
+The reference ships a spark-submit JVM app: parse args → load TFRecords
+with an optional schema hint → run the cached-model Model.transform →
+write JSON predictions.  Same contract here as a console entry point on
+the framework's engine layer (LocalEngine by default, Spark when a
+SparkContext is available), with the C++ recordio reader underneath:
+
+    python -m tensorflowonspark_tpu.inference \\
+        --export_dir /path/export \\
+        --input /path/tfrecords --output /path/preds \\
+        --schema_hint 'struct<image:array<float>,label:bigint>' \\
+        --input_mapping '{"image": "x"}' \\
+        --output_mapping '{"prediction": "preds"}'
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="tensorflowonspark_tpu.inference",
+        description="Batch inference over TFRecords with an exported model",
+    )
+    p.add_argument("--export_dir", required=True,
+                   help="export directory (utils.checkpoint.export_model)")
+    p.add_argument("--input", required=True, help="TFRecord dir or file")
+    p.add_argument("--output", required=True, help="output dir (JSON lines)")
+    p.add_argument("--schema_hint", default=None,
+                   help="struct<name:type,...> partial schema hint")
+    p.add_argument("--input_mapping", default=None,
+                   help='JSON {column: tensor_name}')
+    p.add_argument("--output_mapping", default=None,
+                   help='JSON {tensor_name: column}')
+    p.add_argument("--batch_size", type=int, default=128)
+    p.add_argument("--signature_def_key", default=None,
+                   help="module:function predict override")
+    p.add_argument("--num_executors", type=int, default=2,
+                   help="LocalEngine pool size (ignored under Spark)")
+    return p
+
+
+def run(args, source=None):
+    """Programmatic entry; ``source`` overrides the engine (tests pass a
+    LocalEngine; a live SparkContext works via engine.SparkEngine)."""
+    from tensorflowonspark_tpu import dfutil, pipeline
+    from tensorflowonspark_tpu.engine import LocalEngine
+    from tensorflowonspark_tpu.utils import schema as schema_util
+
+    hint = schema_util.parse_schema(args.schema_hint) if args.schema_hint else {}
+    binary_features = [n for n, (k, _) in hint.items() if k == "bytes"]
+
+    own_engine = source is None
+    engine = source or LocalEngine(num_executors=args.num_executors)
+    try:
+        ds, inferred = dfutil.load_tfrecords(
+            engine, args.input, binary_features=binary_features
+        )
+        schema = schema_util.merge_schemas(inferred, hint)
+        logger.info("input schema: %s", schema_util.format_schema(schema))
+
+        input_mapping = (
+            json.loads(args.input_mapping) if args.input_mapping else None
+        )
+        output_mapping = (
+            json.loads(args.output_mapping) if args.output_mapping else None
+        )
+        # set as ML Params (they win over args in merge_args_params —
+        # same precedence as the reference's TFModel.setExportDir etc.)
+        model = pipeline.TFModel()
+        settings = {
+            "export_dir": args.export_dir,
+            "batch_size": args.batch_size,
+            "input_mapping": input_mapping,
+            "output_mapping": output_mapping,
+            "signature_def_key": args.signature_def_key,
+        }
+        model._set(**{k: v for k, v in settings.items() if v is not None})
+        # rows are dicts; Model.transform selects sorted(input_mapping)
+        # columns — project dicts onto tuples the predictor expects
+        if input_mapping:
+            cols = sorted(input_mapping)
+            ds = ds.map_partitions(
+                _project(cols)
+            )
+        preds = model.transform(ds)
+
+        os.makedirs(args.output, exist_ok=True)
+        shards = preds.map_partitions(_write_json(args.output)).collect()
+        shards = [s for s in shards if s]
+        logger.info("wrote %d shards under %s", len(shards), args.output)
+        return shards
+    finally:
+        if own_engine:
+            engine.stop()
+
+
+def _project(cols):
+    def project(it):
+        return [tuple(row[c] for c in cols) for row in it]
+    return project
+
+
+def _write_json(output_dir):
+    def write(it):
+        import json as _json
+        import os as _os
+        import uuid as _uuid
+
+        rows = list(it)
+        if not rows:
+            return []
+        # unique per partition: pid alone repeats when one executor gets
+        # several partitions, and id()-style keys can collide after reuse
+        path = _os.path.join(
+            output_dir, f"part-{_os.getpid()}-{_uuid.uuid4().hex[:8]}.json"
+        )
+        with open(path, "w") as f:
+            for row in rows:
+                f.write(_json.dumps(row) + "\n")
+        return [path]
+    return write
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    args = build_parser().parse_args(argv)
+    run(args)
+
+
+if __name__ == "__main__":
+    main()

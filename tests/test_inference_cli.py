@@ -1,0 +1,100 @@
+"""Inference CLI + schema parser (parity: Inference.scala +
+SimpleTypeParserTest.scala)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.utils import schema as schema_util
+
+
+# -- SimpleTypeParser parity --------------------------------------------------
+
+def test_parse_roundtrip_all_types():
+    text = ("struct<a:bigint,b:float,c:string,d:binary,"
+            "e:array<float>,f:array<bigint>>")
+    parsed = schema_util.parse_schema(text)
+    assert parsed == {
+        "a": ("int64", False),
+        "b": ("float", False),
+        "c": ("string", False),
+        "d": ("bytes", False),
+        "e": ("float", True),
+        "f": ("int64", True),
+    }
+    assert schema_util.parse_schema(schema_util.format_schema(parsed)) == parsed
+
+
+def test_parse_widening_and_bare_list():
+    assert schema_util.parse_schema("x:boolean,y:int,z:double") == {
+        "x": ("int64", False), "y": ("int64", False), "z": ("float", False),
+    }
+
+
+@pytest.mark.parametrize("bad", ["struct<a:", "a:unknown", "a;int", "x:array<>"])
+def test_parse_errors(bad):
+    with pytest.raises(schema_util.SchemaParseError):
+        schema_util.parse_schema(bad)
+
+
+def test_merge_partial_hint():
+    inferred = {"img": ("string", True), "label": ("int64", False)}
+    hint = schema_util.parse_schema("img:array<binary>")
+    assert schema_util.merge_schemas(inferred, hint) == {
+        "img": ("bytes", True), "label": ("int64", False),
+    }
+
+
+# -- CLI end-to-end -----------------------------------------------------------
+
+def test_inference_cli_end_to_end(tmp_path):
+    """TFRecords -> CLI -> JSON predictions with a linear-model export."""
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import dfutil, inference
+    from tensorflowonspark_tpu.engine import LocalEngine
+    from tensorflowonspark_tpu.utils import checkpoint as ckpt
+
+    # rows: y = 2*x0 + 3*x1
+    rows = [
+        {"features": [float(i), float(2 * i)], "label": float(2 * i + 6 * i)}
+        for i in range(20)
+    ]
+    data_dir = str(tmp_path / "data")
+    dfutil.save_as_tfrecords(rows, data_dir)
+
+    export_dir = str(tmp_path / "export")
+    ckpt.export_model(
+        export_dir,
+        {"w": jnp.asarray([2.0, 3.0]), "b": jnp.asarray(0.0)},
+        metadata={"predict": "tensorflowonspark_tpu.models.linear:predict"},
+    )
+
+    out_dir = str(tmp_path / "preds")
+    args = inference.build_parser().parse_args([
+        "--export_dir", export_dir,
+        "--input", data_dir,
+        "--output", out_dir,
+        "--schema_hint", "struct<features:array<float>,label:float>",
+        "--input_mapping", json.dumps({"features": "x"}),
+        "--output_mapping", json.dumps({"prediction": "preds"}),
+        "--batch_size", "4",
+    ])
+
+    engine = LocalEngine(num_executors=2)
+    try:
+        shards = inference.run(args, source=engine)
+    finally:
+        engine.stop()
+
+    assert shards
+    preds = []
+    for path in sorted(os.listdir(out_dir)):
+        with open(os.path.join(out_dir, path)) as f:
+            preds.extend(json.loads(line) for line in f)
+    assert len(preds) == 20
+    got = sorted(p["preds"] for p in preds)
+    want = sorted(2.0 * i + 3.0 * 2 * i for i in range(20))
+    np.testing.assert_allclose(got, want, atol=1e-5)
