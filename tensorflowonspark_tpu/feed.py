@@ -14,6 +14,7 @@ chunk that overfills the requested batch carries into the next call.
 from __future__ import annotations
 
 import logging
+import time
 
 from tensorflowonspark_tpu import marker
 
@@ -83,12 +84,16 @@ class DataFeed:
         qname_in="input",
         qname_out="output",
         input_mapping=None,
+        metrics=None,
     ):
         self.mgr = mgr
         self.train_mode = train_mode
         self.qname_in = qname_in
         self.qname_out = qname_out
         self.done_feeding = False
+        # optional utils.metrics.TrainMetrics: feed-wait time lands in its
+        # infeed-stall counter (SURVEY.md §5 observability target)
+        self.metrics = metrics
         self.input_tensors = (
             sorted(input_mapping.values()) if input_mapping is not None else None
         )
@@ -99,11 +104,15 @@ class DataFeed:
 
     def _get_chunk(self, timeout_ms=-1):
         """Next chunk from the fast or compat transport (blocking)."""
+        t0 = time.perf_counter() if self.metrics is not None else None
         if self._ring is not None:
-            return self._ring.get(timeout_ms)
-        queue = self.mgr.get_queue(self.qname_in)
-        chunk = queue.get(block=True)
-        queue.task_done()
+            chunk = self._ring.get(timeout_ms)
+        else:
+            queue = self.mgr.get_queue(self.qname_in)
+            chunk = queue.get(block=True)
+            queue.task_done()
+        if t0 is not None:
+            self.metrics.infeed_wait(time.perf_counter() - t0)
         return chunk
 
     def next_batch(self, batch_size):

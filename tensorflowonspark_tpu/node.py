@@ -25,6 +25,7 @@ import json
 import logging
 import multiprocessing
 import os
+import signal
 import socket
 import sys
 import threading
@@ -53,6 +54,7 @@ class _NodeState:
     mgr = None
     cluster_id = None
     ring = None  # shm feed ring (creator side), kept alive for the cluster
+    tb_proc = None  # TensorBoard child of the dashboard node
 
 
 def _get_cluster_spec(cluster_info):
@@ -123,11 +125,14 @@ class TFNodeContext:
         return feed.hdfs_path(self, path)
 
     def get_data_feed(
-        self, train_mode=True, qname_in="input", qname_out="output", input_mapping=None
+        self, train_mode=True, qname_in="input", qname_out="output",
+        input_mapping=None, metrics=None,
     ):
         from tensorflowonspark_tpu.feed import DataFeed
 
-        return DataFeed(self.mgr, train_mode, qname_in, qname_out, input_mapping)
+        return DataFeed(
+            self.mgr, train_mode, qname_in, qname_out, input_mapping, metrics
+        )
 
     def distributed_env(self):
         env = _distributed_env(self.cluster_info)
@@ -152,7 +157,30 @@ class TFNodeContext:
             num_processes=env["num_processes"],
             process_id=env["process_id"],
         )
+        self._jax_distributed = True
         return env
+
+    def sync_exit_barrier(self):
+        """Cross-process barrier run by the node wrapper after user code
+        returns: every process drains its async dispatch queue and waits
+        for its peers before tearing down its collective endpoints.
+
+        Without this, a worker that finishes feeding first exits while a
+        peer's final all-reduce is still in flight and resets the
+        connection mid-collective (the TPU-native analogue of the
+        reference's grace_secs-before-export contract, TFCluster.py:125).
+        """
+        if not getattr(self, "_jax_distributed", False):
+            return
+        try:
+            from jax.experimental import multihost_utils
+
+            # blocks until every process reaches it, and its collective is
+            # ordered after all previously dispatched collectives on every
+            # participant
+            multihost_utils.sync_global_devices("tfos_node_exit")
+        except Exception as e:  # noqa: BLE001 - best-effort on teardown
+            logger.warning("exit barrier failed: %s", e)
 
     def export_env(self):
         """Export bootstrap env vars for subprocesses (TF_CONFIG parity)."""
@@ -250,6 +278,30 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
             "addr": maddr,
             "authkey": cluster_meta["authkey"],
         }
+
+        # dashboard node: spawn TensorBoard before registering so its port
+        # travels with the reservation (TFSparkNode.py:282-319)
+        if (
+            tensorboard
+            and task_index == 0
+            and job_name in ("chief", "master", "worker")
+            and ("chief" not in cluster_meta["cluster_template"]
+                 and "master" not in cluster_meta["cluster_template"]
+                 or job_name in ("chief", "master"))
+        ):
+            from tensorflowonspark_tpu.utils import profiler as _profiler
+
+            tb_dir = log_dir or os.path.join(
+                cluster_meta["working_dir"], "tensorboard",
+                f"cluster-{cluster_meta['id'] & 0xffffffff:x}",
+            )
+            _NodeState.tb_proc, tb_port = _profiler.launch_tensorboard(tb_dir)
+            if tb_port:
+                node_meta["tb_port"] = tb_port
+                # pid in the manager KV so the shutdown closure (which may
+                # run in a different python worker) can kill the child
+                mgr.set("tb_pid", _NodeState.tb_proc.pid)
+
         client.register(node_meta)
         cluster_info = client.await_reservations(
             timeout=cluster_meta.get("reservation_timeout", 600)
@@ -278,6 +330,8 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
             if isinstance(args, list):
                 sys.argv = args
             fn(args, context)
+            # all processes leave together (see sync_exit_barrier docstring)
+            context.sync_exit_barrier()
 
         def wrapper_fn_background(args, context):
             errq = mgr.get_queue("error")
@@ -493,6 +547,17 @@ def shutdown(cluster_info, queues, cluster_id, grace_secs=0):
         executor_id = read_executor_id()
         mgr = _get_manager(cluster_info, get_ip_address(), executor_id)
         logger.info("shutdown: signalling end-of-feed on executor %s", executor_id)
+        tb_pid = mgr.get("tb_pid")  # kill TB child (TFSparkNode.py:599-605)
+        if tb_pid:
+            try:
+                os.kill(int(str(tb_pid)), signal.SIGKILL)
+            except (OSError, ValueError):
+                pass
+            try:  # reap when this worker happens to be the spawning parent
+                os.waitpid(int(str(tb_pid)), 0)
+            except (ChildProcessError, OSError, ValueError):
+                pass
+            mgr.set("tb_pid", None)
         ring = _open_feed_ring(mgr, "input")
         for qname in queues:
             if qname in ("error", "control"):

@@ -1,0 +1,117 @@
+"""Step-time / throughput / MFU / infeed-stall counters.
+
+The reference has no metrics at all (SURVEY.md §5 "Observability = log
+lines"); the ≥50% MFU north star needs them.  One lightweight
+``TrainMetrics`` aggregator per worker: time steps with ``step()``,
+account feed-wait with ``infeed_wait()`` (DataFeed calls this
+internally when handed a metrics object), read a structured summary with
+``report()``.
+
+MFU convention: model FLOPs per step / (step time x peak FLOPs), peak
+resolved from the device kind like bench.py.  FLOPs estimators for the
+zoo's families are provided (6ND for transformers, 2 x MACs for convs is
+the caller's number).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+logger = logging.getLogger(__name__)
+
+# bf16 peak FLOP/s per chip by device-kind substring (same table as bench.py)
+PEAK_FLOPS = {
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v4": 275e12,
+    "v5p": 459e12,
+    "v6": 918e12,
+}
+
+
+def peak_flops(device=None):
+    env = os.environ.get("TFOS_PEAK_FLOPS")
+    if env:
+        return float(env)
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for k, v in PEAK_FLOPS.items():
+        if k in kind:
+            return v
+    return None  # unknown (CPU): MFU not reported
+
+
+def transformer_flops_per_token(cfg):
+    """~6N FLOPs/token (fwd+bwd) + attention term, from the config."""
+    n_params = (
+        cfg.vocab_size * cfg.dim * 2
+        + cfg.n_layers * (cfg.dim * cfg.dim * 4 + cfg.dim * cfg.dim * cfg.mlp_ratio * 2)
+    )
+    attn = 12 * cfg.n_layers * cfg.dim * cfg.max_seq  # 2*2*3 * L * d * S
+    return 6 * n_params + attn
+
+
+class TrainMetrics:
+    """Windowed counters; cheap enough for the hot loop."""
+
+    def __init__(self, flops_per_item=None, device=None, window=50):
+        self.flops_per_item = flops_per_item
+        self.window = window
+        self._peak = peak_flops(device) if flops_per_item else None
+        self.reset()
+
+    def reset(self):
+        self.steps = 0
+        self.items = 0
+        self.step_time = 0.0
+        self.infeed_time = 0.0
+        self._last = None
+
+    # -- recording ----------------------------------------------------------
+
+    def infeed_wait(self, seconds):
+        self.infeed_time += seconds
+
+    def step(self, items=0):
+        """Call once per completed train step with the item count.
+
+        The first call only arms the timer; its items are NOT counted, so
+        rates divide N timed steps' items by N timed steps' time."""
+        now = time.perf_counter()
+        if self._last is not None:
+            self.step_time += now - self._last
+            self.items += items
+        self._last = now
+        self.steps += 1
+
+    # -- reading ------------------------------------------------------------
+
+    def report(self):
+        """Summary dict over the window since reset(); rates need >=2
+        step() calls (the first call only arms the timer)."""
+        out = {
+            "steps": self.steps,
+            "items": self.items,
+            "step_time_avg_s": self.step_time / max(self.steps - 1, 1),
+            "infeed_wait_s": self.infeed_time,
+            "infeed_stall_frac": (
+                self.infeed_time / self.step_time if self.step_time else 0.0
+            ),
+        }
+        if self.step_time:
+            out["items_per_sec"] = self.items / self.step_time
+            if self.flops_per_item and self._peak:
+                out["mfu"] = (
+                    self.items * self.flops_per_item
+                    / self.step_time / self._peak
+                )
+        return out
+
+    def maybe_log(self, prefix=""):
+        if self.steps and self.steps % self.window == 0:
+            logger.info("%smetrics: %s", prefix, self.report())

@@ -1,0 +1,114 @@
+"""Dashboard + device profiler (parity: the TensorBoard subprocess spawn
+of reference TFSparkNode.py:282-319, plus the XLA/TPU profiler capture
+the reference lacked — SURVEY.md §5 "Tracing: new build adds native
+XLA/TPU profiler capture").
+
+``launch_tensorboard`` mirrors the reference's behavior: port from
+``TENSORBOARD_PORT`` or ephemeral, binary found next to the python
+executable / on PATH / via PYTHONPATH module fallback, child killed at
+node shutdown.  ``trace``/``start_trace``/``stop_trace`` wrap
+``jax.profiler`` so each worker can drop a device trace (HLO timelines,
+MXU utilization) into the same log_dir TensorBoard serves.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import socket
+import subprocess
+import sys
+import time
+
+logger = logging.getLogger(__name__)
+
+
+def _find_tensorboard():
+    """Locate a tensorboard executable (TFSparkNode.py:299-311 order:
+    python bin dir, then PATH)."""
+    candidates = [
+        os.path.join(os.path.dirname(sys.executable), "tensorboard"),
+    ]
+    from tensorflowonspark_tpu.utils.hostinfo import find_in_path
+
+    on_path = find_in_path(os.environ.get("PATH", ""), "tensorboard")
+    if on_path:
+        candidates.append(on_path)
+    for c in candidates:
+        if c and os.path.isfile(c) and os.access(c, os.X_OK):
+            return [c]
+    try:  # module fallback (no console script installed)
+        import tensorboard  # noqa: F401
+
+        return [sys.executable, "-m", "tensorboard.main"]
+    except ImportError:
+        return None
+
+
+def launch_tensorboard(log_dir, port=None):
+    """Spawn TensorBoard on ``log_dir``; returns (process, port) or
+    (None, None) when no tensorboard is installed (logged, not fatal)."""
+    cmd = _find_tensorboard()
+    if not cmd:
+        logger.warning("tensorboard not found; dashboard disabled")
+        return None, None
+    if port is None:
+        if os.environ.get("TENSORBOARD_PORT"):
+            port = int(os.environ["TENSORBOARD_PORT"])
+        else:
+            with socket.socket() as s:  # ephemeral pick
+                s.bind(("", 0))
+                port = s.getsockname()[1]
+    os.makedirs(log_dir, exist_ok=True)
+    tb_log = os.path.join(log_dir, "tensorboard.log")
+    with open(tb_log, "ab") as sink:
+        proc = subprocess.Popen(
+            cmd + ["--logdir", log_dir, "--port", str(port), "--bind_all"],
+            stdout=sink,
+            stderr=sink,
+        )
+    # liveness check: an ephemeral port can be stolen between release and
+    # the child's bind, and a bad install dies instantly — don't advertise
+    # a dashboard that isn't running
+    time.sleep(1.0)
+    if proc.poll() is not None:
+        logger.warning(
+            "tensorboard exited immediately (rc=%s); see %s",
+            proc.returncode, tb_log,
+        )
+        return None, None
+    logger.info("TensorBoard pid=%d port=%d logdir=%s", proc.pid, port, log_dir)
+    return proc, port
+
+
+def stop_tensorboard(proc):
+    if proc is not None and proc.poll() is None:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+def start_trace(log_dir):
+    """Begin an XLA device trace (viewable in TensorBoard's profile tab)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_trace():
+    import jax
+
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def trace(log_dir, enabled=True):
+    """``with profiler.trace(log_dir): step(...)`` around hot steps."""
+    if not enabled:
+        yield
+        return
+    start_trace(log_dir)
+    try:
+        yield
+    finally:
+        stop_trace()
