@@ -1,0 +1,12 @@
+"""Sphinx configuration (parity: reference docs/source/conf.py)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath("../.."))
+
+project = "tensorflowonspark_tpu"
+author = "tensorflowonspark_tpu authors"
+extensions = ["sphinx.ext.autodoc", "sphinx.ext.napoleon", "sphinx.ext.viewcode"]
+autodoc_mock_imports = ["jax", "jaxlib", "optax", "numpy", "cloudpickle"]
+html_theme = "alabaster"

@@ -1,0 +1,88 @@
+"""Prepare MNIST-shaped data as CSV and TFRecords
+(parity: reference examples/mnist/mnist_data_setup.py:41-65, which writes
+RDD CSV + TFRecords via the Hadoop OutputFormat).
+
+This environment has no egress, so by default a deterministic synthetic
+set with learnable structure is generated (same generator as
+mnist_spark.py); pass --from_csv to convert a real MNIST CSV dump
+(label,pix0,...,pix783 per line) instead.
+
+    python examples/mnist/mnist_data_setup.py --output /tmp/mnist
+    # -> /tmp/mnist/csv/part-00000...  /tmp/mnist/tfr/part-r-...
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+
+def synthetic_mnist(n, seed=0):
+    """(images [n,28,28,1] float32 in [0,1], labels [n] int32 0..7):
+    label = argmax quadrant brightness x overall-brightness bit."""
+    rng = np.random.default_rng(seed)
+    images = rng.random((n, 28, 28, 1), dtype=np.float32)
+    q = np.stack(
+        [images[:, :14, :14, 0].mean((1, 2)), images[:, :14, 14:, 0].mean((1, 2)),
+         images[:, 14:, :14, 0].mean((1, 2)), images[:, 14:, 14:, 0].mean((1, 2))],
+        axis=-1)
+    labels = (np.argmax(q, axis=-1) * 2 + (q.sum(-1) > 2.0)).astype(np.int32)
+    return images, labels
+
+
+def load_csv_dir(csv_dir):
+    rows = []
+    for fname in sorted(os.listdir(csv_dir)):
+        with open(os.path.join(csv_dir, fname)) as f:
+            for line in f:
+                vals = np.fromstring(line, dtype=np.float32, sep=",")
+                rows.append((vals[1:].reshape(28, 28, 1) / 255.0, int(vals[0])))
+    images = np.stack([r[0] for r in rows]).astype(np.float32)
+    labels = np.asarray([r[1] for r in rows], dtype=np.int32)
+    return images, labels
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--output", default="/tmp/mnist")
+    p.add_argument("--num_examples", type=int, default=2048)
+    p.add_argument("--num_partitions", type=int, default=4)
+    p.add_argument("--from_csv", default=None,
+                   help="existing MNIST CSV dir to convert instead of synthetic")
+    args = p.parse_args()
+
+    from tensorflowonspark_tpu import dfutil
+
+    if args.from_csv:
+        images, labels = load_csv_dir(args.from_csv)
+    else:
+        images, labels = synthetic_mnist(args.num_examples)
+
+    # CSV shards (label,pix...) — the reference's RDD-of-CSV format
+    csv_dir = os.path.join(args.output, "csv")
+    os.makedirs(csv_dir, exist_ok=True)
+    per = (len(images) + args.num_partitions - 1) // args.num_partitions
+    for shard in range(args.num_partitions):
+        lo, hi = shard * per, min((shard + 1) * per, len(images))
+        with open(os.path.join(csv_dir, f"part-{shard:05d}"), "w") as f:
+            for i in range(lo, hi):
+                pix = ",".join(
+                    str(int(v)) for v in (images[i].ravel() * 255).astype(np.int64)
+                )
+                f.write(f"{labels[i]},{pix}\n")
+
+    # TFRecords via the native writer (tensorflow-hadoop jar equivalent)
+    tfr_dir = os.path.join(args.output, "tfr")
+    rows = [
+        {"image": [float(v) for v in images[i].ravel()], "label": int(labels[i])}
+        for i in range(len(images))
+    ]
+    dfutil.save_as_tfrecords(rows, tfr_dir)
+    print(f"wrote {len(images)} examples: {csv_dir} and {tfr_dir}")
+
+
+if __name__ == "__main__":
+    main()

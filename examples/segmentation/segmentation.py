@@ -1,0 +1,73 @@
+"""U-Net segmentation, single process — step 1 of the conversion ladder
+(parity: reference examples/segmentation/segmentation.py; the reference's
+3-step story is single-process → TF_CONFIG distributed → TFoS; here:
+single-process → multi-chip mesh (segmentation_dist.py) → cluster-fed
+(segmentation_spark.py)).
+
+    python examples/segmentation/segmentation.py --steps 10
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+
+def synthetic_pets(n, hw=64, seed=0):
+    """Images with a bright disc; mask = {0: background, 1: disc, 2: rim}."""
+    rng = np.random.default_rng(seed)
+    images = rng.random((n, hw, hw, 3), dtype=np.float32) * 0.3
+    masks = np.zeros((n, hw, hw), dtype=np.int32)
+    yy, xx = np.mgrid[:hw, :hw]
+    for i in range(n):
+        cy, cx = rng.integers(hw // 4, 3 * hw // 4, 2)
+        r = int(rng.integers(hw // 8, hw // 4))
+        d2 = (yy - cy) ** 2 + (xx - cx) ** 2
+        disc, rim = d2 <= (r - 2) ** 2, (d2 > (r - 2) ** 2) & (d2 <= r**2)
+        images[i][disc] += 0.6
+        images[i][rim] += 0.3
+        masks[i][disc], masks[i][rim] = 1, 2
+    return np.clip(images, 0, 1), masks
+
+
+def train(args):
+    import jax
+    import optax
+
+    from tensorflowonspark_tpu.models import segmentation
+
+    images, masks = synthetic_pets(args.batch_size * 4, hw=args.image_size)
+    params, state = segmentation.init(
+        jax.random.PRNGKey(0), num_classes=3, width=args.width
+    )
+    opt = optax.adam(args.lr)
+    opt_state = opt.init(params)
+    step_fn = jax.jit(segmentation.make_train_step(opt))
+
+    rng = np.random.default_rng(0)
+    for step in range(1, args.steps + 1):
+        idx = rng.integers(0, len(images), args.batch_size)
+        params, state, opt_state, loss = step_fn(
+            params, state, opt_state, images[idx], masks[idx]
+        )
+        if step % 5 == 0:
+            print(f"step {step}: loss={float(loss):.4f}")
+    return params, state
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch_size", type=int, default=8)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--image_size", type=int, default=64)
+    p.add_argument("--width", type=float, default=0.5)
+    p.add_argument("--lr", type=float, default=1e-3)
+    args = p.parse_args()
+    train(args)
+
+
+if __name__ == "__main__":
+    main()

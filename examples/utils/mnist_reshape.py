@@ -1,0 +1,27 @@
+"""Reshape a flat-784 MNIST CSV row into a 28x28 ASCII preview
+(parity: reference examples/utils/mnist_reshape.py — a 9-line inference
+debugging helper).
+
+    python examples/utils/mnist_reshape.py "0,0,...,255"
+"""
+
+import sys
+
+import numpy as np
+
+
+def reshape(csv_row):
+    vals = np.fromstring(csv_row, dtype=np.float32, sep=",")
+    pixels = vals[1:] if len(vals) == 785 else vals
+    img = pixels.reshape(28, 28)
+    scale = " .:-=+*#%@"
+    lines = [
+        "".join(scale[min(int(v / 256.0 * len(scale)), len(scale) - 1)]
+                for v in row)
+        for row in img
+    ]
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":
+    print(reshape(sys.argv[1]))

@@ -1,0 +1,97 @@
+"""TPU VM cluster launcher (parity: reference scripts/spark_ec2.py — the
+cluster-bringup utility; that one provisioned EC2 + Spark Standalone,
+this one provisions a GCP TPU pod slice + the framework's rendezvous).
+
+Requires ``gcloud`` and network access; in an egress-free environment
+every action is printed as a dry run (--dry_run is implied when gcloud
+is absent), so the exact commands remain auditable.
+
+    python scripts/tpu_launch.py create  --name tfos --zone us-central2-b \\
+        --accelerator v5litepod-16
+    python scripts/tpu_launch.py run     --name tfos -- python train.py
+    python scripts/tpu_launch.py delete  --name tfos
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import shutil
+import subprocess
+import sys
+
+SETUP = (
+    "pip install -e . && "
+    "sudo mkdir -p /opt/tfos && sudo chown $USER /opt/tfos"
+)
+
+
+def gcloud_available():
+    return shutil.which("gcloud") is not None
+
+
+def _run(cmd, dry):
+    print("+ " + " ".join(shlex.quote(c) for c in cmd))
+    if dry:
+        return 0
+    return subprocess.call(cmd)
+
+
+def cmd_create(args, dry):
+    cmd = [
+        "gcloud", "compute", "tpus", "tpu-vm", "create", args.name,
+        "--zone", args.zone,
+        "--accelerator-type", args.accelerator,
+        "--version", args.runtime_version,
+    ]
+    rc = _run(cmd, dry)
+    if rc == 0 and args.setup:
+        rc = cmd_ssh_all(args, dry, SETUP)
+    return rc
+
+
+def cmd_ssh_all(args, dry, command):
+    return _run([
+        "gcloud", "compute", "tpus", "tpu-vm", "ssh", args.name,
+        "--zone", args.zone, "--worker=all", "--command", command,
+    ], dry)
+
+
+def cmd_run(args, dry):
+    # every host runs the same driver command; the framework's rendezvous
+    # (TFOS_SERVER_HOST/PORT point workers at the server, reservation
+    # parity reservation.py:25-26) assembles them into one cluster
+    extra = " ".join(shlex.quote(c) for c in args.command)
+    return cmd_ssh_all(args, dry, extra)
+
+
+def cmd_delete(args, dry):
+    return _run([
+        "gcloud", "compute", "tpus", "tpu-vm", "delete", args.name,
+        "--zone", args.zone, "--quiet",
+    ], dry)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("action", choices=["create", "run", "delete"])
+    p.add_argument("--name", required=True)
+    p.add_argument("--zone", default="us-central2-b")
+    p.add_argument("--accelerator", default="v5litepod-16")
+    p.add_argument("--runtime_version", default="tpu-ubuntu2204-base")
+    p.add_argument("--setup", action="store_true",
+                   help="pip-install the framework on every worker after create")
+    p.add_argument("--dry_run", action="store_true")
+    p.add_argument("command", nargs="*", help="command for `run` (after --)")
+    args = p.parse_args(argv)
+
+    dry = args.dry_run or not gcloud_available()
+    if dry and not args.dry_run:
+        print("gcloud not found — dry run only", file=sys.stderr)
+    return {
+        "create": cmd_create, "run": cmd_run, "delete": cmd_delete,
+    }[args.action](args, dry)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

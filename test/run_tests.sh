@@ -1,0 +1,19 @@
+#!/bin/bash
+# Test entry point (parity: reference test/run_tests.sh, which boots a
+# local Spark Standalone cluster before `unittest discover`).
+#
+# The equivalent multi-process fixture here is built in: LocalEngine
+# starts real executor *processes* (engine.py), and multi-chip sharding
+# runs on a virtual 8-device CPU mesh (tests/conftest.py) — so no
+# external daemons are needed.  With pyspark installed, the same suite
+# exercises the SparkEngine adapters automatically where applicable.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# build the native library if a toolchain is present (tests fall back to
+# the pure-python recordio/queue implementations without it)
+if command -v g++ >/dev/null 2>&1; then
+  make -C native >/dev/null || echo "native build failed; using python fallbacks"
+fi
+
+exec python -m pytest tests/ -q "$@"
