@@ -124,7 +124,9 @@ def apply(params, state, images, depth=50, train=True, small_inputs=False,
     x, new_state["bn_stem"] = L.batchnorm(params["bn_stem"], state["bn_stem"], x, train)
     x = L.relu(x)
     if not small_inputs:
-        x = L.max_pool(x, window=3, stride=2)
+        # SAME padding: 112 -> 56 (the standard ResNet stem; VALID's 55
+        # also breaks the TPU's (8,128) tiling on every stage-1 tensor)
+        x = L.max_pool(x, window=3, stride=2, padding="SAME")
     for stage, nblocks in enumerate(counts):
         for b in range(nblocks):
             stride = 2 if (b == 0 and stage > 0) else 1

@@ -85,8 +85,8 @@ def mha_reference(q, k, v, *, causal=False, scale=None, q_offset=0, kv_offset=0)
 
 # -- pallas flash attention ---------------------------------------------------
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, seq_q, seq_kv, block_q,
-                  block_kv, scale, causal):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, seq_q, seq_kv,
+                  block_q, block_kv, scale, causal):
     """One program of grid (B*H, num_q_blocks): one [block_q, D] q tile
     against the whole (masked) kv range."""
     import jax.experimental.pallas as pl
@@ -133,6 +133,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, seq_q, seq_kv, block_q,
     # fully-masked rows (tail padding) have l == 0; avoid 0/0
     out = acc / jnp.maximum(l, 1e-30)[:, None]
     o_ref[0] = out.astype(o_ref.dtype)
+    # log-sum-exp per row, saved for the O(S*block) backward; trailing
+    # singleton keeps the block TPU-tileable (block_q x 1 vs the (8,128)
+    # divisibility rule)
+    lse_ref[0, :, 0] = m + jnp.log(jnp.maximum(l, 1e-30))
 
 
 def _flash_forward(q, k, v, *, causal, scale, block_q, block_kv, interpret):
@@ -165,7 +169,7 @@ def _flash_forward(q, k, v, *, causal, scale, block_q, block_kv, interpret):
         scale=scale,
         causal=causal,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -173,48 +177,119 @@ def _flash_forward(q, k, v, *, causal, scale, block_q, block_kv, interpret):
             pl.BlockSpec((1, skv + pad_kv, d), lambda bh, i: (bh, 0, 0)),
             pl.BlockSpec((1, skv + pad_kv, d), lambda bh, i: (bh, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq + pad_q, d), q.dtype),
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, i: (bh, i, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * h, sq + pad_q, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq + pad_q, 1), jnp.float32),
+        ),
         interpret=interpret,
     )(qf, kf, vf)
     out = out[:, :sq].reshape(b, h, sq, d).transpose(0, 2, 1, 3)
-    return out
+    lse = lse[:, :sq, 0].reshape(b, h, sq)  # [B, H, Sq]
+    return out, lse
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, scale, block_q, block_kv, interpret):
-    return _flash_forward(
+    out, _lse = _flash_forward(
         q, k, v, causal=causal, scale=scale, block_q=block_q,
         block_kv=block_kv, interpret=interpret,
     )
+    return out
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
-    out = _flash(q, k, v, causal, scale, block_q, block_kv, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(
+        q, k, v, causal=causal, scale=scale, block_q=block_q,
+        block_kv=block_kv, interpret=interpret,
+    )
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_kv, interpret, res, g):
-    # rematerialized backward through the reference formulation — the
-    # forward stores only (q, k, v), flash-style; the O(S^2) scores exist
-    # only transiently inside XLA's fused backward.
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: mha_reference(q_, k_, v_, causal=causal, scale=scale),
-        q, k, v,
+    """Blockwise flash backward (pure XLA, lax.scan over q blocks).
+
+    Memory is O(block_q * S_kv) per step instead of the O(S^2) score
+    matrix a naive softmax backward materializes — per-block scores are
+    recomputed from (q, k) and renormalized with the saved logsumexp:
+        p   = exp(s - lse)
+        dv += p^T g
+        ds  = p * (g v^T - rowsum(g * out))
+        dq  = scale * ds k ;  dk += scale * ds^T q
+    """
+    q, k, v, out, lse = res
+    b, sq, h, d = q.shape
+    skv = k.shape[1]
+    block = min(block_q, max(sq, 8))
+    pad_q = (-sq) % block
+    nb = (sq + pad_q) // block
+
+    def heads(x):  # [B, S, H, D] -> [B, H, S, D] f32
+        return x.transpose(0, 2, 1, 3).astype(jnp.float32)
+
+    qt, gt, ot = heads(q), heads(g), heads(out)
+    kt, vt = heads(k), heads(v)
+    delta = jnp.sum(gt * ot, axis=-1)  # [B, H, Sq]
+
+    def padq(x):
+        return jnp.pad(x, ((0, 0), (0, 0), (0, pad_q)) + ((0, 0),) * (x.ndim - 3))
+
+    # stack q blocks on a leading scan axis: [nb, B, H, block, ...]
+    qb = padq(qt).reshape(b, h, nb, block, d).transpose(2, 0, 1, 3, 4)
+    gb = padq(gt).reshape(b, h, nb, block, d).transpose(2, 0, 1, 3, 4)
+    lseb = padq(lse).reshape(b, h, nb, block).transpose(2, 0, 1, 3)
+    deltab = padq(delta).reshape(b, h, nb, block).transpose(2, 0, 1, 3)
+    qpos = jnp.pad(jnp.arange(sq), (0, pad_q), constant_values=-1).reshape(
+        nb, block
     )
-    return vjp(g)
+    kpos = jnp.arange(skv)
+
+    def body(carry, xs):
+        dk_acc, dv_acc = carry
+        q_i, g_i, lse_i, delta_i, qpos_i = xs
+        s = jnp.einsum("bhqd,bhkd->bhqk", q_i, kt) * scale
+        valid = (qpos_i[:, None] >= 0) & (kpos[None, :] < skv)
+        if causal:
+            valid &= qpos_i[:, None] >= kpos[None, :]
+        p = jnp.where(valid[None, None], jnp.exp(s - lse_i[..., None]), 0.0)
+        dv_acc = dv_acc + jnp.einsum("bhqk,bhqd->bhkd", p, g_i)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", g_i, vt)
+        ds = p * (dp - delta_i[..., None]) * scale
+        dq_i = jnp.einsum("bhqk,bhkd->bhqd", ds, kt)
+        dk_acc = dk_acc + jnp.einsum("bhqk,bhqd->bhkd", ds, q_i)
+        return (dk_acc, dv_acc), dq_i
+
+    zeros = jnp.zeros((b, h, skv, d), jnp.float32)
+    (dk, dv), dq_blocks = lax.scan(
+        body, (zeros, zeros), (qb, gb, lseb, deltab, qpos)
+    )
+    dq = dq_blocks.transpose(1, 2, 0, 3, 4).reshape(b, h, nb * block, d)
+    dq = dq[:, :, :sq]
+
+    def unheads(x, like):  # [B, H, S, D] -> [B, S, H, D] in input dtype
+        return x.transpose(0, 2, 1, 3).astype(like.dtype)
+
+    return unheads(dq, q), unheads(dk, k), unheads(dv, v)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, *, causal=False, scale=None, block_q=128,
-                    block_kv=128, interpret=None):
+def flash_attention(q, k, v, *, causal=False, scale=None, block_q=512,
+                    block_kv=512, interpret=None):
     """Flash attention on [B, S, H, D]; differentiable.
 
     ``interpret=None`` auto-selects: compiled pallas on TPU, interpreter
     mode elsewhere (CPU tests / virtual-device meshes).
+
+    Defaults tuned on v5e (B=4, S=2048, H=8, D=128: 512/512 is ~4x the
+    128/128 throughput).  The kernel keeps the full k/v sequence of one
+    head in VMEM, so S*D*4 bytes must stay well under the ~16MB budget —
+    beyond ~32k tokens at D=128, shard the sequence (parallel/ring.py)
+    or shrink block_kv.
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
