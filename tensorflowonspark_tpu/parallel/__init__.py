@@ -26,3 +26,8 @@ from tensorflowonspark_tpu.parallel.sharding import (  # noqa: F401
     replicated_sharding,
     shard_train_state,
 )
+from tensorflowonspark_tpu.parallel.pipeline_parallel import (  # noqa: F401
+    pipeline_apply,
+    stack_stage_params,
+    stage_sharding,
+)

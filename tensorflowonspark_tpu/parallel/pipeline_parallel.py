@@ -1,0 +1,101 @@
+"""Pipeline parallelism: GPipe-style microbatching over a 'pp' mesh axis.
+
+Absent from the reference (its model parallelism was "users place ops",
+SURVEY.md §2.3); here stages are placed on devices along a named mesh
+axis and activations flow stage-to-stage over ICI via ``lax.ppermute``
+inside ``shard_map``:
+
+- stage parameters are stacked on a leading axis sharded P('pp', ...)
+  — device i holds stage i's weights only;
+- the batch is split into m microbatches; at step t, device i runs
+  microbatch t-i (the classic pipeline schedule — bubble fraction
+  (S-1)/(m+S-1));
+- everything is one jittable function, differentiable end to end
+  (ppermute has a transpose, the schedule is a lax.scan).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tensorflowonspark_tpu.parallel.ring import shard_map
+
+
+def pipeline_apply(stage_fn, stage_params, x, *, mesh, n_microbatches,
+                   axis_name="pp"):
+    """Run ``stage_fn(params_i, x) -> x`` through S pipelined stages.
+
+    stage_params: pytree stacked on a leading stage axis of size S
+    (shard it P('pp', ...)).  x: [B, ...] global batch; B must divide by
+    n_microbatches.  Returns the final stage's output, same shape as x
+    (stage_fn must preserve shape — pad/project inside the stage
+    otherwise).
+    """
+    n_stages = mesh.shape[axis_name]
+    b = x.shape[0]
+    assert b % n_microbatches == 0, (b, n_microbatches)
+    mb = b // n_microbatches
+
+    # microbatch-major: [m, mb, ...]
+    xm = x.reshape(n_microbatches, mb, *x.shape[1:])
+
+    def pipelined(params, xm):
+        # inside shard_map: params = this device's stage (leading axis 1),
+        # xm = the full microbatch stream (replicated over pp)
+        params = jax.tree.map(lambda p: p[0], params)
+        idx = lax.axis_index(axis_name)
+        shift = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        total = n_microbatches + n_stages - 1
+        state = jnp.zeros_like(xm[0])  # activation entering this device
+        outputs = jnp.zeros_like(xm)
+
+        def step(carry, t):
+            state, outputs = carry
+            # stage 0 ingests microbatch t (when in range)
+            take = jnp.clip(t, 0, n_microbatches - 1)
+            state = jnp.where(idx == 0, xm[take], state)
+            y = stage_fn(params, state)
+            # device i finishes microbatch t-i; the last stage banks it
+            out_idx = jnp.clip(t - (n_stages - 1), 0, n_microbatches - 1)
+            bank = (idx == n_stages - 1) & (t >= n_stages - 1)
+            outputs = jnp.where(
+                bank,
+                lax.dynamic_update_index_in_dim(outputs, y, out_idx, 0),
+                outputs,
+            )
+            # hand activations to the next stage
+            state = lax.ppermute(y, axis_name, shift)
+            return (state, outputs), None
+
+        (state, outputs), _ = lax.scan(
+            step, (state, outputs), jnp.arange(total)
+        )
+        # everyone returns the last stage's bank; psum-of-one-hot keeps it
+        # replicated (only the last stage holds nonzero outputs)
+        keep = (idx == n_stages - 1).astype(outputs.dtype)
+        return lax.psum(outputs * keep, axis_name)
+
+    pspec = jax.tree.map(lambda _: P(axis_name), stage_params)
+    out = shard_map(
+        pipelined,
+        mesh,
+        in_specs=(pspec, P()),
+        out_specs=P(),
+    )(stage_params, xm)
+    return out.reshape(b, *x.shape[1:])
+
+
+def stack_stage_params(params_list):
+    """[per-stage pytrees] -> one pytree with a leading stage axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *params_list)
+
+
+def stage_sharding(mesh, stage_params, axis_name="pp"):
+    """NamedShardings placing the stacked stage axis on ``axis_name``."""
+    return jax.tree.map(
+        lambda _: NamedSharding(mesh, P(axis_name)), stage_params
+    )
