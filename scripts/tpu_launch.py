@@ -15,14 +15,15 @@ is absent), so the exact commands remain auditable.
 from __future__ import annotations
 
 import argparse
+import os
 import shlex
 import shutil
 import subprocess
 import sys
 
 SETUP = (
-    "pip install -e . && "
-    "sudo mkdir -p /opt/tfos && sudo chown $USER /opt/tfos"
+    "cd /opt/tfos && pip install -e . && "
+    "python -c 'import tensorflowonspark_tpu'"
 )
 
 
@@ -46,7 +47,19 @@ def cmd_create(args, dry):
     ]
     rc = _run(cmd, dry)
     if rc == 0 and args.setup:
-        rc = cmd_ssh_all(args, dry, SETUP)
+        # ship the source tree, then install it on every worker
+        rc = cmd_ssh_all(args, dry, "sudo mkdir -p /opt/tfos && "
+                                    "sudo chown $USER /opt/tfos")
+        if rc == 0:
+            repo_root = os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))
+            rc = _run([
+                "gcloud", "compute", "tpus", "tpu-vm", "scp", "--recurse",
+                f"{repo_root}/", f"{args.name}:/opt/tfos",
+                "--zone", args.zone, "--worker=all",
+            ], dry)
+        if rc == 0:
+            rc = cmd_ssh_all(args, dry, SETUP)
     return rc
 
 

@@ -408,6 +408,16 @@ class SparkEngine:
     def __init__(self, sc):
         self.sc = sc
         self.num_executors = int(sc.getConf().get("spark.executor.instances", "1"))
+        # the node runtime assumes a fixed executor set for the cluster's
+        # lifetime (parity: TFSparkNode.py:138-143 hard-fails the same way)
+        if sc.getConf().get(
+            "spark.dynamicAllocation.enabled", "false"
+        ).strip().lower() == "true":
+            raise RuntimeError(
+                "TFCluster requires spark.dynamicAllocation.enabled=false: "
+                "executors host long-lived framework nodes and must not be "
+                "reclaimed mid-job"
+            )
 
     @property
     def default_fs(self):
