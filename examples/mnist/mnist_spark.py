@@ -28,6 +28,9 @@ def main_fun(args, ctx):
     from tensorflowonspark_tpu.parallel import make_mesh, local_to_global
     from tensorflowonspark_tpu.utils import checkpoint as ckpt
 
+    from tensorflowonspark_tpu import infeed
+    from tensorflowonspark_tpu.utils import metrics as M
+
     env = ctx.jax_initialize()
     mesh = make_mesh({"data": -1})
     params = mnist.init_params(jax.random.PRNGKey(0))
@@ -35,20 +38,28 @@ def main_fun(args, ctx):
     opt_state = opt.init(params)
     step_fn = jax.jit(mnist.make_train_step(opt))
 
-    feed = ctx.get_data_feed(train_mode=True)
+    # double-buffered device staging + infeed-stall accounting: the
+    # background thread collates/stages batch t+1 while t trains
+    tm = M.TrainMetrics(window=10)
+    feed = ctx.get_data_feed(train_mode=True, metrics=tm)
     per_proc = args["batch_size"] // max(env["num_processes"], 1)
-    step = loss = acc = 0
-    while not feed.should_stop():
-        batch = feed.next_batch(per_proc)
-        if len(batch) < per_proc:
-            continue
+
+    def collate(batch):
         images = np.stack([b[0] for b in batch]).astype(np.float32)
         labels = np.asarray([b[1] for b in batch], dtype=np.int32)
-        gi, gl = local_to_global(mesh, (images, labels))
+        return images, labels
+
+    step = loss = acc = 0
+    for gi, gl in infeed.device_feed(
+        feed, per_proc, collate=collate,
+        placement=lambda b: local_to_global(mesh, b),
+    ):
         params, opt_state, loss, acc = step_fn(params, opt_state, gi, gl)
+        tm.step(items=per_proc)
         step += 1
         if step % 10 == 0 and ctx.task_index == 0:
-            print(f"step {step}: loss={float(loss):.4f} acc={float(acc):.3f}")
+            print(f"step {step}: loss={float(loss):.4f} acc={float(acc):.3f} "
+                  f"metrics={tm.report()}")
 
     if ckpt.is_chief(ctx):  # chief-only persistence (compat.py:10-17 parity)
         ckpt.save_checkpoint(os.path.join(args["model_dir"], "ckpt"), params, step)

@@ -49,6 +49,7 @@ _LAZY = {
     "TFParallel": ("tensorflowonspark_tpu.parallel_run", None),
     "compat": ("tensorflowonspark_tpu.compat", None),
     "dfutil": ("tensorflowonspark_tpu.dfutil", None),
+    "infeed": ("tensorflowonspark_tpu.infeed", None),
     "pipeline": ("tensorflowonspark_tpu.pipeline", None),
 }
 

@@ -135,7 +135,16 @@ class TFCluster:
                     placement=worker_ids,
                 )
 
-            # wait for the node-launcher thread (workers run to completion)
+            # drive ps/evaluator to stop via their remote managers
+            # (TFCluster.py:186-194).  This MUST precede joining the
+            # launcher: ps/evaluator node tasks hold their engine slots
+            # until the control message arrives, so the launcher job
+            # cannot complete before they are told to stop.
+            for m in ps_eval:
+                _stop_remote_node(m)
+
+            # wait for the node-launcher thread (all nodes now run to
+            # completion)
             if self._launcher is not None:
                 self._launcher.join(timeout=timeout)
 
@@ -143,19 +152,6 @@ class TFCluster:
                 logger.error("cluster failed: %s", tf_status["error"])
                 self.engine.cancel_all_jobs()
                 sys.exit(1)
-
-            # drive ps/evaluator to stop via their remote managers
-            # (TFCluster.py:186-194)
-            for m in ps_eval:
-                try:
-                    mgr = tfmanager.connect(
-                        tuple(m["addr"]), bytes.fromhex(m["authkey"])
-                    )
-                    mgr.get_queue("control").put(None, block=True)
-                except Exception as e:  # noqa: BLE001
-                    logger.warning(
-                        "could not stop %s:%s: %s", m["job_name"], m["task_index"], e
-                    )
         finally:
             watchdog.cancel()
             self.server.stop()
@@ -170,6 +166,35 @@ class TFCluster:
         return None
 
     _launcher = None
+
+
+def _stop_remote_node(m):
+    """control.put(None) on a ps/evaluator's remote manager, with a
+    connect timeout and a loopback fallback (the advertised host may be
+    a non-routable discovery address in sandboxed single-host setups)."""
+    import socket as _socket
+
+    addr = tuple(m["addr"])
+    candidates = [addr]
+    if addr[0] not in ("127.0.0.1", "localhost"):
+        candidates.append(("127.0.0.1", addr[1]))
+    old = _socket.getdefaulttimeout()
+    _socket.setdefaulttimeout(15)
+    last = None
+    try:
+        for cand in candidates:
+            try:
+                mgr = tfmanager.connect(cand, bytes.fromhex(m["authkey"]))
+                mgr.get_queue("control").put(None, block=True)
+                return
+            except Exception as e:  # noqa: BLE001 - try next candidate
+                last = e
+        logger.warning(
+            "could not stop %s:%s at %s: %s",
+            m["job_name"], m["task_index"], candidates, last,
+        )
+    finally:
+        _socket.setdefaulttimeout(old)
 
 
 def run(

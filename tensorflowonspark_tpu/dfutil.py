@@ -173,3 +173,13 @@ def is_loaded_df(path):
     """Provenance check (parity: dfutil.isLoadedDF :18-26): True if this
     path was produced by load_tfrecords in this process."""
     return path in loaded_schemas
+
+
+# reference-spelling aliases (dfutil.py public surface is camelCase) so
+# ported call sites work unchanged
+saveAsTFRecords = save_as_tfrecords
+loadTFRecords = load_tfrecords
+toTFExample = to_example
+fromTFExample = from_example
+inferSchema = infer_schema
+isLoadedDF = is_loaded_df

@@ -190,3 +190,52 @@ class DataFeed:
                     queue.task_done()
             except Exception:  # noqa: BLE001 - Empty/Timeout = fully drained
                 done = True
+
+
+def start_cluster_server(ctx, num_gpus=1, rdma=False):
+    """Deprecated TF1-era API (TFNode.py:67-151): in the reference this
+    started a tf.train.Server on the reserved port.  TPU-native jobs have
+    no per-node gRPC server; joining the cluster is ctx.jax_initialize().
+    Kept so ported main_funs run; returns an object with a .target-like
+    coordinator address.
+    """
+    import warnings
+
+    warnings.warn(
+        "start_cluster_server is deprecated; use ctx.jax_initialize()",
+        DeprecationWarning,
+        stacklevel=2,
+    )
+    env = ctx.jax_initialize()
+
+    class _Server:  # minimal tf.train.Server stand-in
+        target = env.get("coordinator_address")
+
+        @staticmethod
+        def join():
+            raise RuntimeError(
+                "server.join() has no TPU equivalent; ps-style blocking is "
+                "handled by the framework's control queue"
+            )
+
+    return _Server()
+
+
+def export_saved_model(sess=None, export_dir=None, tag_set=None,
+                       signatures=None, params=None, ctx=None,
+                       metadata=None):
+    """Deprecated TF1-era export (TFNode.py:159-208).  The TPU-native
+    export is utils.checkpoint.export_model(export_dir, params, ctx);
+    this shim forwards to it (chief-only contract preserved)."""
+    import warnings
+
+    from tensorflowonspark_tpu.utils import checkpoint as _ckpt
+
+    warnings.warn(
+        "TFNode.export_saved_model is deprecated; use "
+        "utils.checkpoint.export_model",
+        DeprecationWarning,
+        stacklevel=2,
+    )
+    assert export_dir is not None and params is not None
+    return _ckpt.export_model(export_dir, params, ctx, metadata=metadata)

@@ -1,0 +1,99 @@
+"""Infeed pipelining: overlap host feed/conversion with device compute.
+
+SURVEY.md §7 step 10's perf work ("infeed pipelining, double-buffering,
+per-host sharded feeding"): the naive InputMode.SPARK loop is
+  next_batch (host) -> np.stack (host) -> device_put -> step (device)
+with the device idle during the host phases.  ``prefetch_to_device``
+runs those host phases on a background thread ``depth`` batches ahead,
+so the accelerator consumes batch t while t+1..t+depth are already
+staged in HBM — the TPU-native analogue of the reference's
+tf.data prefetch between DataFeed and model.fit
+(examples/mnist/keras/mnist_spark.py:33-66).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue as _queue
+import threading
+
+logger = logging.getLogger(__name__)
+
+_END = object()
+
+
+def batch_iterator(feed, batch_size, collate=None, min_batch=None):
+    """DataFeed -> iterator of collated host batches.
+
+    ``collate(records) -> pytree of np arrays`` (default: identity);
+    short tails below ``min_batch`` (default: batch_size) are dropped,
+    matching the examples' skip-short-batch convention so SPMD steps
+    always see full shapes (no recompilation, no ragged collectives).
+    """
+    min_batch = batch_size if min_batch is None else min_batch
+    while not feed.should_stop():
+        records = feed.next_batch(batch_size)
+        n = len(next(iter(records.values()))) if isinstance(records, dict) \
+            else len(records)
+        if n < min_batch:
+            continue
+        yield collate(records) if collate is not None else records
+
+
+def prefetch_to_device(it, depth=2, placement=None):
+    """Stage ``it``'s batches onto devices ``depth`` ahead.
+
+    placement: None (default device_put), a Sharding, or a callable
+    pytree->pytree (e.g. ``lambda b: local_to_global(mesh, b)`` for
+    multi-host global arrays).  Exceptions on the worker thread re-raise
+    at the consuming iteration.
+    """
+    import jax
+
+    if placement is None or not callable(placement):
+        sharding = placement
+
+        def place(batch):
+            return jax.device_put(batch, sharding)
+    else:
+        place = placement
+
+    q = _queue.Queue(maxsize=depth)
+
+    def worker():
+        try:
+            for batch in it:
+                q.put(place(batch))
+        except Exception as e:  # noqa: BLE001 - forwarded to consumer
+            q.put(("__prefetch_error__", e))
+        finally:
+            q.put(_END)
+
+    t = threading.Thread(target=worker, daemon=True, name="tfos-prefetch")
+    t.start()
+
+    while True:
+        item = q.get()
+        if item is _END:
+            return
+        if isinstance(item, tuple) and len(item) == 2 \
+                and item[0] == "__prefetch_error__":
+            raise item[1]
+        yield item
+
+
+def device_feed(feed, batch_size, *, collate=None, depth=2, placement=None,
+                min_batch=None):
+    """The composed fast path: DataFeed -> collate -> double-buffered
+    device staging.  Drop-in for the examples' while-loop:
+
+        for batch in device_feed(ctx.get_data_feed(), per_proc,
+                                 collate=my_collate,
+                                 placement=lambda b: local_to_global(mesh, b)):
+            params, ... = step_fn(params, ..., *batch)
+    """
+    return prefetch_to_device(
+        batch_iterator(feed, batch_size, collate, min_batch),
+        depth=depth,
+        placement=placement,
+    )

@@ -1,0 +1,70 @@
+"""Infeed pipelining: batching, collation, prefetch ordering, errors."""
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import infeed
+
+
+class FakeFeed:
+    """DataFeed stand-in delivering scripted batches."""
+
+    def __init__(self, batches):
+        self.batches = list(batches)
+
+    def should_stop(self):
+        return not self.batches
+
+    def next_batch(self, n):
+        return self.batches.pop(0)
+
+
+def test_batch_iterator_drops_short_tail():
+    feed = FakeFeed([[1] * 8, [2] * 8, [3] * 3])
+    got = list(infeed.batch_iterator(feed, 8))
+    assert got == [[1] * 8, [2] * 8]
+
+
+def test_batch_iterator_dict_records_and_collate():
+    feed = FakeFeed([{"x": [1, 2], "y": [3, 4]}])
+    got = list(infeed.batch_iterator(
+        feed, 2, collate=lambda r: np.asarray(r["x"]) + np.asarray(r["y"])
+    ))
+    np.testing.assert_array_equal(got[0], [4, 6])
+
+
+def test_prefetch_preserves_order_and_values():
+    batches = [np.full((4,), i) for i in range(10)]
+    out = list(infeed.prefetch_to_device(iter(batches), depth=3))
+    assert len(out) == 10
+    for i, b in enumerate(out):
+        np.testing.assert_array_equal(np.asarray(b), np.full((4,), i))
+
+
+def test_prefetch_forwards_worker_exception():
+    def gen():
+        yield np.zeros((2,))
+        raise ValueError("boom in feed")
+
+    it = infeed.prefetch_to_device(gen(), depth=2)
+    next(it)
+    with pytest.raises(ValueError, match="boom in feed"):
+        list(it)
+
+
+def test_device_feed_places_on_sharding(eight_devices):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tensorflowonspark_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"data": 4}, devices=eight_devices[:4])
+    sharding = NamedSharding(mesh, P("data"))
+    feed = FakeFeed([[float(i) for i in range(8)]])
+    out = list(infeed.device_feed(
+        feed, 8, collate=lambda r: np.asarray(r, np.float32),
+        placement=sharding,
+    ))
+    assert len(out) == 1
+    assert out[0].sharding.is_equivalent_to(sharding, out[0].ndim)
+    np.testing.assert_array_equal(np.asarray(out[0]), np.arange(8.0))

@@ -1,0 +1,78 @@
+"""Role placement (ps/evaluator/chief) + reference API aliases/shims."""
+
+import os
+import warnings
+
+import pytest
+
+from tensorflowonspark_tpu import cluster as TFCluster
+from tensorflowonspark_tpu.cluster import InputMode
+from tensorflowonspark_tpu.engine import LocalEngine
+
+
+def _role_writer_fn(args, ctx):
+    path = os.path.join(args["dir"], f"{ctx.job_name}-{ctx.task_index}")
+    with open(path, "w") as f:
+        f.write(str(ctx.executor_id))
+
+
+def test_ps_eval_chief_roles_run_and_stop(tmp_path):
+    """num_ps + eval_node + chief template: ps/evaluator run the user fn
+    in a background process and block their slot until the driver's
+    shutdown control message (reference TFSparkNode.py:411-438
+    semantics)."""
+    engine = LocalEngine(4)
+    try:
+        cluster = TFCluster.run(
+            engine, _role_writer_fn, {"dir": str(tmp_path)},
+            num_executors=4, num_ps=1, eval_node=True,
+            master_node="chief", input_mode=InputMode.TENSORFLOW,
+        )
+        jobs = sorted(m["job_name"] for m in cluster.cluster_info)
+        assert jobs == ["chief", "evaluator", "ps", "worker"]
+        cluster.shutdown(grace_secs=1)
+    finally:
+        engine.stop()
+    wrote = sorted(os.listdir(tmp_path))
+    assert wrote == ["chief-0", "evaluator-0", "ps-0", "worker-0"], wrote
+
+
+def test_dfutil_camelcase_aliases():
+    from tensorflowonspark_tpu import dfutil
+
+    assert dfutil.saveAsTFRecords is dfutil.save_as_tfrecords
+    assert dfutil.loadTFRecords is dfutil.load_tfrecords
+    assert dfutil.toTFExample is dfutil.to_example
+    assert dfutil.fromTFExample is dfutil.from_example
+    assert dfutil.inferSchema is dfutil.infer_schema
+    assert dfutil.isLoadedDF is dfutil.is_loaded_df
+
+
+def test_deprecated_tfnode_shims(tmp_path):
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import feed
+
+    class Ctx:
+        job_name, task_index = "chief", 0
+        cluster_spec = {"chief": [{}]}
+
+        def jax_initialize(self):
+            return {"coordinator_address": None, "num_processes": 1,
+                    "process_id": 0}
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        server = feed.start_cluster_server(Ctx())
+        assert any(issubclass(x.category, DeprecationWarning) for x in w)
+    with pytest.raises(RuntimeError):
+        server.join()
+
+    export_dir = str(tmp_path / "exp")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        feed.export_saved_model(
+            export_dir=export_dir, params={"w": jnp.zeros((2,))}, ctx=Ctx()
+        )
+        assert any(issubclass(x.category, DeprecationWarning) for x in w)
+    assert os.path.isdir(export_dir)
