@@ -1,0 +1,117 @@
+"""Profile the ResNet-50 train step on the attached TPU and print the
+top ops by self-time, grouped by fusion kind.
+
+Usage: python scripts/profile_resnet.py [--steps N] [--batch N]
+Writes the xplane trace under /tmp/tfos_profile and parses it with the
+tensorflow xplane protobuf (no TensorBoard needed).
+"""
+
+import argparse
+import glob
+import os
+import time
+from collections import defaultdict
+
+import numpy as np
+
+
+def parse_xplane(logdir):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = glob.glob(os.path.join(logdir, "**", "*.xplane.pb"), recursive=True)
+    if not paths:
+        raise SystemExit(f"no xplane.pb under {logdir}")
+    xspace = xplane_pb2.XSpace()
+    with open(sorted(paths)[-1], "rb") as f:
+        xspace.ParseFromString(f.read())
+    return xspace
+
+
+def summarize(xspace, top=40):
+    # find the TPU device plane (op-level events live there)
+    for plane in xspace.planes:
+        if "TPU" in plane.name or "/device:" in plane.name:
+            ev_names = plane.event_metadata
+            totals = defaultdict(float)
+            counts = defaultdict(int)
+            for line in plane.lines:
+                if "XLA Ops" not in line.name and "Ops" != line.name.strip():
+                    continue
+                for ev in line.events:
+                    name = ev_names[ev.metadata_id].name
+                    totals[name] += ev.duration_ps / 1e9  # ms
+                    counts[name] += 1
+            if totals:
+                yield plane.name, totals, counts
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--image", type=int, default=224)
+    ap.add_argument("--s2d", action="store_true", help="space-to-depth stem")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax import lax
+
+    from tensorflowonspark_tpu.models import resnet
+
+    params, state = resnet.init(jax.random.PRNGKey(0), depth=50, num_classes=1000)
+    opt = optax.sgd(0.1, momentum=0.9)
+    opt_state = opt.init(params)
+    step_fn = resnet.make_train_step(opt, depth=50)
+
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.random((args.batch, args.image, args.image, 3),
+                                    dtype=np.float32), dtype=jnp.bfloat16)
+    labels = jnp.asarray(rng.integers(0, 1000, args.batch), dtype=jnp.int32)
+
+    @jax.jit
+    def run_steps(params, state, opt_state, images, labels):
+        def body(carry, _):
+            p, s, o = carry
+            p, s, o, loss, _ = step_fn(p, s, o, images, labels)
+            return (p, s, o), loss
+        (_, _, _), losses = lax.scan(body, (params, state, opt_state),
+                                     None, length=args.steps)
+        return losses[-1]
+
+    print("compiling...", flush=True)
+    float(run_steps(params, state, opt_state, images, labels))
+    t0 = time.perf_counter()
+    float(run_steps(params, state, opt_state, images, labels))
+    dt = time.perf_counter() - t0
+    ms_per_step = 1000 * dt / args.steps
+    print(f"step={ms_per_step:.1f}ms  img/s={args.batch / (dt / args.steps):.0f}",
+          flush=True)
+
+    import shutil
+
+    logdir = "/tmp/tfos_profile"
+    shutil.rmtree(logdir, ignore_errors=True)
+    jax.profiler.start_trace(logdir)
+    float(run_steps(params, state, opt_state, images, labels))
+    jax.profiler.stop_trace()
+
+    xspace = parse_xplane(logdir)
+    for plane_name, totals, counts in summarize(xspace):
+        total = sum(totals.values())
+        print(f"\n== {plane_name}  total {total:.1f}ms over {args.steps} steps ==")
+        # group by fusion-kind prefix
+        groups = defaultdict(float)
+        for name, ms in totals.items():
+            key = name.split(".")[0].split("_")[0]
+            groups[key] += ms
+        for k, v in sorted(groups.items(), key=lambda kv: -kv[1])[:15]:
+            print(f"  [group] {k:30s} {v:8.2f}ms {100 * v / total:5.1f}%")
+        print()
+        for name, ms in sorted(totals.items(), key=lambda kv: -kv[1])[:40]:
+            print(f"  {ms:8.2f}ms x{counts[name]:<4d} {100 * ms / total:5.1f}%  {name[:110]}")
+
+
+if __name__ == "__main__":
+    main()

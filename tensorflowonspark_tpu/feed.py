@@ -175,19 +175,32 @@ class DataFeed:
         Sets state to 'terminating' so feeder tasks that land later skip
         straight to draining; then empties what is already queued so the
         producer's queue.join() returns.
+
+        Ring path: "drained" is decided by the producer flock, not a
+        timeout — an empty ring only ends the drain once no feeder holds
+        the producer lock, so a slow producer mid-partition cannot strand
+        data (and its _await_consumption) behind a 5s guess.
         """
         logger.info("terminate() invoked")
         self.mgr.set("state", "terminating")
+        if self._ring is not None:
+            from tensorflowonspark_tpu.recordio import shm as shmq
+
+            while True:
+                try:
+                    if self._ring.get(timeout_ms=1000) is None:
+                        break  # producer closed the ring: EOF
+                except TimeoutError:
+                    if (self._ring.qsize_bytes() == 0
+                            and not shmq.producer_active(self._ring.name)):
+                        break
+            return
         done = False
         while not done:
             try:
-                if self._ring is not None:
-                    if self._ring.get(timeout_ms=5000) is None:
-                        done = True
-                else:
-                    queue = self.mgr.get_queue(self.qname_in)
-                    queue.get(block=True, timeout=5)
-                    queue.task_done()
+                queue = self.mgr.get_queue(self.qname_in)
+                queue.get(block=True, timeout=5)
+                queue.task_done()
             except Exception:  # noqa: BLE001 - Empty/Timeout = fully drained
                 done = True
 

@@ -445,29 +445,58 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
         queue = None if ring is not None else mgr.get_queue(qname)
 
         def put(chunk):
+            """False once the consumer requested termination mid-feed: a
+            put blocked on a full ring re-checks state each second, so a
+            feeder never deadlocks against a consumer that stopped
+            draining."""
             if ring is not None:
-                ring.put(chunk)
+                while True:
+                    try:
+                        ring.put(chunk, timeout_ms=1000)
+                        return True
+                    except TimeoutError:
+                        if str(mgr.get("state")) == "terminating":
+                            return False
             else:
                 queue.put(chunk, block=True)
+                return True
 
         total = 0
+        terminated = False
         chunk = []
         for item in iterator:
             chunk.append(item)
             if len(chunk) >= FEED_CHUNK_RECORDS:
-                put(chunk)
+                if not put(chunk):
+                    terminated = True
+                    break
                 total += len(chunk)
                 chunk = []
-        if chunk:
-            put(chunk)
-            total += len(chunk)
+        if chunk and not terminated:
+            if put(chunk):
+                total += len(chunk)
+            else:
+                terminated = True
+        # a feeder that passed the entry state check before terminate()
+        # set the flag may have queued its whole (small) partition without
+        # any put ever blocking — re-check here so it never waits on a
+        # consumer that already stopped draining
+        if not terminated and str(mgr.get("state")) == "terminating":
+            terminated = True
+        if terminated:
+            discarded = sum(1 for _ in iterator)
+            logger.info("feeder: termination mid-feed, discarded %d records",
+                        discarded + len(chunk))
         logger.info("feeder: queued %d records (%s path)", total,
                     "shm" if ring is not None else "manager")
 
         if ring is not None:
-            _await_consumption(
-                mgr, lambda: ring.qsize_bytes() > 0, feed_timeout, poll=0.2
-            )
+            if not terminated:
+                # terminate()'s drain loop keeps reading while we hold the
+                # producer flock, so outstanding bytes always reach zero
+                _await_consumption(
+                    mgr, lambda: ring.qsize_bytes() > 0, feed_timeout, poll=0.2
+                )
             ring.close()
         else:
             joining = threading.Thread(target=queue.join, daemon=True)

@@ -12,8 +12,9 @@ Axis convention (any subset may be size 1):
   ``fsdp``  — parameter sharding over the data axis group (ZeRO-style)
   ``model`` — tensor/model parallel
   ``seq``   — sequence/context parallel (ring attention)
-  ``pipe``  — pipeline stages
-  ``expert``— MoE expert parallel
+  ``pp``    — pipeline stages
+  ``ep``    — MoE expert parallel
+("pipe" and "expert" are accepted as aliases of pp/ep.)
 """
 
 from __future__ import annotations
@@ -26,7 +27,10 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
-AXIS_ORDER = ("pipe", "data", "fsdp", "seq", "expert", "model")
+AXIS_ORDER = ("pp", "data", "fsdp", "seq", "ep", "model")
+
+# accepted alternate spellings -> canonical axis name
+AXIS_ALIASES = {"pipe": "pp", "expert": "ep"}
 
 
 @dataclass
@@ -36,7 +40,12 @@ class MeshSpec:
     axes: dict = field(default_factory=dict)
 
     def resolve(self, n_devices):
-        sizes = dict(self.axes)
+        sizes = {AXIS_ALIASES.get(k, k): v for k, v in self.axes.items()}
+        if len(sizes) != len(self.axes):
+            raise ValueError(
+                f"mesh axes {list(self.axes)} collide after alias "
+                f"canonicalization ({AXIS_ALIASES})"
+            )
         unknown = [k for k, v in sizes.items() if v == -1]
         known = math.prod(v for v in sizes.values() if v != -1)
         if len(unknown) > 1:

@@ -16,6 +16,37 @@ import pickle
 from tensorflowonspark_tpu.recordio import native as _native
 
 
+def _lock_path(name):
+    import tempfile
+
+    return os.path.join(
+        tempfile.gettempdir(), f".tfosq{name.replace('/', '_')}.lock"
+    )
+
+
+def producer_active(name):
+    """True while some producer holds the ring's exclusive producer flock.
+
+    Lets a draining consumer distinguish "ring momentarily empty but a
+    feeder is still mid-partition" from "truly no more data coming"
+    without guessing from timeouts (the reference had to guess,
+    TFNode.py:307-329; the flock makes the check race-free here)."""
+    import fcntl
+
+    try:
+        f = open(_lock_path(name), "w")
+    except OSError:
+        return False
+    try:
+        fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        fcntl.flock(f, fcntl.LOCK_UN)
+        return False
+    except OSError:
+        return True
+    finally:
+        f.close()
+
+
 class ShmQueue:
     """Producer or consumer endpoint of a named shm ring.
 
@@ -35,12 +66,8 @@ class ShmQueue:
         self._lockf = None
         if producer and not create:
             import fcntl
-            import tempfile
 
-            lockpath = os.path.join(
-                tempfile.gettempdir(), f".tfosq{name.replace('/', '_')}.lock"
-            )
-            self._lockf = open(lockpath, "w")
+            self._lockf = open(_lock_path(name), "w")
             fcntl.flock(self._lockf, fcntl.LOCK_EX)
         if create:
             self._h = lib.shq_create(name.encode(), capacity)

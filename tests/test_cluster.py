@@ -70,7 +70,29 @@ def _stream_consumer_fn(args, ctx):
         f.write(str(total))
 
 
+def _role_marker_fn(args, ctx):
+    with open(f"role_{ctx.job_name}_{ctx.task_index}", "w") as f:
+        f.write(str(ctx.executor_id))
+
+
 # --- tests ------------------------------------------------------------------
+
+def test_driver_ps_nodes(engine, tmp_path, monkeypatch):
+    """driver_ps_nodes=True hosts ps on driver threads with executor ids
+    past the engine pool (parity: TFCluster.py:229,240-241,296-314)."""
+    monkeypatch.chdir(tmp_path)  # the driver-hosted ps writes marker here
+    cluster = TFCluster.run(
+        engine, _role_marker_fn, [], num_executors=2, num_ps=1,
+        driver_ps_nodes=True, input_mode=InputMode.TENSORFLOW,
+    )
+    jobs = {(m["job_name"], m["task_index"]): m for m in cluster.cluster_info}
+    assert ("ps", 0) in jobs
+    # ps occupies the id *after* the engine executors (reference contract:
+    # cluster_size = num_executors + num_ps when driver-hosted)
+    assert jobs[("ps", 0)]["executor_id"] == 2
+    assert len(jobs) == 3
+    cluster.shutdown()  # must stop the ps via its remote manager, not hang
+    assert (tmp_path / "role_ps_0").exists(), "ps user fn never ran"
 
 def test_independent_nodes(engine):
     cluster = TFCluster.run(

@@ -1,0 +1,38 @@
+"""Mesh construction: axis canonicalization, ordering, -1 inference."""
+
+import pytest
+
+from tensorflowonspark_tpu.parallel import make_mesh
+from tensorflowonspark_tpu.parallel.mesh import AXIS_ORDER, MeshSpec
+
+
+def test_axis_order_uses_short_names():
+    assert "pp" in AXIS_ORDER and "ep" in AXIS_ORDER
+    assert "pipe" not in AXIS_ORDER and "expert" not in AXIS_ORDER
+
+
+def test_aliases_canonicalize():
+    sizes = MeshSpec({"pipe": 2, "expert": 4}).resolve(8)
+    assert sizes == {"pp": 2, "ep": 4}
+
+
+def test_alias_collision_rejected():
+    with pytest.raises(ValueError, match="collide"):
+        MeshSpec({"pipe": 2, "pp": 2}).resolve(4)
+
+
+def test_make_mesh_orders_axes(eight_devices):
+    mesh = make_mesh({"model": 2, "pp": 2, "data": 2}, devices=eight_devices)
+    assert mesh.axis_names == ("pp", "data", "model")
+    assert dict(mesh.shape) == {"pp": 2, "data": 2, "model": 2}
+
+
+def test_make_mesh_accepts_aliases(eight_devices):
+    mesh = make_mesh({"expert": 4, "pipe": 2}, devices=eight_devices)
+    assert mesh.axis_names == ("pp", "ep")
+    assert dict(mesh.shape) == {"pp": 2, "ep": 4}
+
+
+def test_minus_one_absorbs_remainder(eight_devices):
+    mesh = make_mesh({"model": 2, "data": -1}, devices=eight_devices)
+    assert dict(mesh.shape) == {"data": 4, "model": 2}
