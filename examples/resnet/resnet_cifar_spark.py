@@ -35,6 +35,17 @@ def main_fun(args, ctx):
     )
     opt = optax.sgd(args["lr"], momentum=0.9)
     opt_state = opt.init(params)
+
+    # resume-from-checkpoint (the framework's recovery contract: restart
+    # the job, pick up params/BN-state/optimizer/step from the newest
+    # checkpoint in model_dir)
+    ckpt_dir = os.path.join(args["model_dir"], "ckpt")
+    restored, start_step = ckpt.restore_latest(ckpt_dir)
+    if restored is not None:
+        params = restored["params"]
+        state = restored["state"]
+        opt_state = ckpt.unpack_pytree(restored["opt"], opt_state)
+
     (params, state, opt_state), (p_sh, s_sh, o_sh) = shard_train_state(
         mesh, params, state, opt_state
     )
@@ -48,7 +59,17 @@ def main_fun(args, ctx):
 
     feed = ctx.get_data_feed(train_mode=True)
     per_proc = args["batch_size"] // max(env["num_processes"], 1)
-    step = 0
+    save_every = args.get("save_every", 25)
+
+    def save(step):
+        ckpt.save_checkpoint(
+            ckpt_dir,
+            {"params": params, "state": state,
+             "opt": ckpt.pack_pytree(opt_state)},
+            step,
+        )
+
+    step = start_step
     while not feed.should_stop():
         batch = feed.next_batch(per_proc)
         if len(batch) < per_proc:
@@ -62,11 +83,11 @@ def main_fun(args, ctx):
         step += 1
         if step % 5 == 0 and ctx.task_index == 0:
             print(f"step {step}: loss={float(loss):.4f} acc={float(acc):.3f}")
+        if step % save_every == 0 and ckpt.is_chief(ctx):
+            save(step)
 
     if ckpt.is_chief(ctx):
-        ckpt.save_checkpoint(
-            os.path.join(args["model_dir"], "ckpt"), params, step
-        )
+        save(step)
 
 
 def main():

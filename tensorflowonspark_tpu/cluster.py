@@ -62,6 +62,16 @@ class TFCluster:
         """
         logger.info("feeding training data")
         assert self.input_mode == InputMode.SPARK, "train() requires InputMode.SPARK"
+        if hasattr(dataset, "foreachRDD"):
+            # Spark Streaming DStream (parity: TFCluster.py:83-85): every
+            # micro-batch RDD's partitions are fed through the same
+            # feeder closure; stop via DataFeed.terminate -> STOP ->
+            # shutdown(ssc=...)'s awaitTerminationOrTimeout loop.
+            feeder = node.train(
+                self.cluster_info, self.cluster_meta, feed_timeout, qname
+            )
+            dataset.foreachRDD(lambda rdd: rdd.foreachPartition(feeder))
+            return
         ds = engine_mod.as_dataset(dataset)
         assert num_epochs >= 0, "num_epochs cannot be negative"
         if num_epochs > 1:
@@ -124,6 +134,15 @@ class TFCluster:
         watchdog.daemon = True
         watchdog.start()
         try:
+            # Spark Streaming: wait for the StreamingContext to terminate,
+            # stopping it ourselves once a consumer's STOP reaches the
+            # rendezvous server (parity: TFCluster.py:146-153)
+            if ssc is not None:
+                while not ssc.awaitTerminationOrTimeout(1):
+                    if self.server.done.is_set():
+                        logger.info("server done, stopping StreamingContext")
+                        ssc.stop(stopSparkContext=False, stopGraceFully=True)
+                        break
             # signal end-of-feed on every worker's queues
             worker_ids = sorted(m["executor_id"] for m in workers)
             if worker_ids:

@@ -372,7 +372,17 @@ class LocalEngine:
 # ----------------------------------------------------------------------------
 
 class SparkDataset:
-    """RDD wrapper exposing the Dataset contract."""
+    """RDD wrapper exposing the Dataset contract.
+
+    ``spread``/``placement`` map onto Spark **barrier execution**
+    (``rdd.barrier()``): all partitions are scheduled concurrently, one
+    per free slot — the strongest placement guarantee Spark offers.
+    True executor *pinning* does not exist on Spark; node identity is
+    recovered the reference's way instead, by executor-id-file
+    reattachment on whichever executor a task lands
+    (TFSparkNode.py:119-146), so barrier's distinct-slot guarantee is
+    exactly what the node-launch and shutdown closures need.
+    """
 
     def __init__(self, rdd):
         self.rdd = rdd
@@ -385,14 +395,21 @@ class SparkDataset:
         return SparkDataset(self.rdd.mapPartitions(fn))
 
     def foreach_partition(self, fn, spread=False, placement=None):
-        self.rdd.foreachPartition(fn)
+        if spread or placement is not None:
+            def _run(it, _fn=fn):
+                _fn(it)
+                return iter([0])
+
+            self.rdd.barrier().mapPartitions(_run).count()
+        else:
+            self.rdd.foreachPartition(fn)
 
     def collect(self, spread=False):
         if spread:
-            logger.warning(
-                "collect(spread=True) is a no-op on Spark; use "
-                "rdd.barrier() for one-task-per-slot scheduling"
-            )
+            def _identity(it):
+                return it
+
+            return self.rdd.barrier().mapPartitions(_identity).collect()
         return self.rdd.collect()
 
     def union(self, *others):

@@ -465,17 +465,20 @@ def _run_model(args):
             logger.info("loaded model %s into worker cache", key)
         predict, params = _pipeline._model_cache[key]
 
+        from tensorflowonspark_tpu.recordio import marshal
+
         results = []
         for batch in yield_batch(iterator, args.batch_size):
             if input_tensors is None:
                 inputs = {"inputs": np.asarray(batch)}
             else:
-                cols = list(zip(*batch)) if batch and isinstance(
-                    batch[0], (tuple, list)
-                ) else [batch]
-                inputs = {
-                    t: np.asarray(cols[i]) for i, t in enumerate(input_tensors)
-                }
+                # native row-batch -> dense-column marshalling (parity:
+                # TFModel.scala:51-114 batch2tensors, compiled path)
+                if batch and isinstance(batch[0], (tuple, list)):
+                    cols = marshal.rows_to_columns(batch)
+                else:
+                    cols = (np.asarray(batch),)
+                inputs = {t: cols[i] for i, t in enumerate(input_tensors)}
             outputs = predict(params, inputs)
             if not isinstance(outputs, dict):
                 name = out_pairs[0][0] if out_pairs else "outputs"
@@ -485,20 +488,16 @@ def _run_model(args):
             for v in outputs.values():
                 assert len(v) == n, f"output rows {len(v)} != input rows {n}"
             names = [t for t, _ in out_pairs] if out_pairs else sorted(outputs)
-            cols_out = [_column(outputs[t]) for t in names]
             out_names = [c for _, c in out_pairs] if out_pairs else names
-            for i in range(n):
-                results.append({c: col[i] for c, col in zip(out_names, cols_out)})
+            # dense columns -> rows (parity: TFModel.scala:121-239
+            # tensors2batch, compiled path)
+            row_tuples = marshal.columns_to_rows([outputs[t] for t in names])
+            results.extend(
+                dict(zip(out_names, row)) for row in row_tuples
+            )
         return results
 
     return _predict_partition
-
-
-def _column(arr):
-    """ndarray → list of python scalars / lists (row-major)."""
-    if arr.ndim <= 1:
-        return arr.tolist()
-    return [row.tolist() for row in arr]
 
 
 def _load_predictor(export_dir, args):

@@ -1,0 +1,167 @@
+"""Row-batch ⇄ typed-column marshalling, native when available.
+
+Parity target: the reference's JVM marshalling layer
+(TFModel.scala:51-239 batch2tensors/tensors2batch), where the per-dtype
+conversion between rows and dense tensors runs in compiled code.  Here
+the compiled path is the ``_tfos_marshal`` CPython extension
+(native/marshal.c); a numpy fallback implements identical semantics so
+behavior does not depend on the native build.
+
+Dtype codes (mirror of the reference's supported SQL type matrix):
+  '?' bool  'i' int32  'l' int64  'f' float32  'd' float64  'O' object
+A column spec entry is ``(code, width)``: width 0 for scalar columns,
+w>0 for fixed-length sequence columns (shape [n, w]).
+"""
+
+from __future__ import annotations
+
+import importlib.machinery
+import importlib.util
+import os
+
+import numpy as np
+
+_ext = None
+_ext_tried = False
+
+_CODE_TO_DTYPE = {"?": np.bool_, "i": np.int32, "l": np.int64,
+                  "f": np.float32, "d": np.float64}
+
+
+def _load_ext():
+    global _ext, _ext_tried
+    if _ext_tried:
+        return _ext
+    _ext_tried = True
+    if os.environ.get("TFOS_NATIVE_MARSHAL", "1") == "0":
+        return None
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    path = os.path.join(here, "native", "_tfos_marshal.so")
+    if not os.path.exists(path):
+        return None
+    try:
+        loader = importlib.machinery.ExtensionFileLoader("_tfos_marshal", path)
+        spec = importlib.util.spec_from_loader("_tfos_marshal", loader)
+        mod = importlib.util.module_from_spec(spec)
+        loader.exec_module(mod)
+        _ext = mod
+    except Exception:  # noqa: BLE001 - fall back to numpy
+        _ext = None
+    return _ext
+
+
+def native_available():
+    return _load_ext() is not None
+
+
+def infer_spec(row):
+    """Column spec from one example row (the schema-less path; the CLI's
+    schema_hint translates to an explicit spec via schema_to_spec)."""
+    spec = []
+    for v in row:
+        if isinstance(v, (bool, np.bool_)):
+            spec.append(("?", 0))
+        elif isinstance(v, (int, np.integer)):
+            spec.append(("l", 0))
+        elif isinstance(v, (float, np.floating)):
+            spec.append(("d", 0))
+        elif isinstance(v, (bytes, str)):
+            spec.append(("O", 0))
+        elif isinstance(v, np.ndarray):
+            spec.append((np.asarray(v).dtype.char.replace("b", "?"), len(v)))
+        elif isinstance(v, (list, tuple)):
+            if not v:
+                raise ValueError("cannot infer dtype of empty sequence column")
+            inner = v[0]
+            if isinstance(inner, (bool, np.bool_)):
+                spec.append(("?", len(v)))
+            elif isinstance(inner, (int, np.integer)):
+                spec.append(("l", len(v)))
+            elif isinstance(inner, (float, np.floating)):
+                spec.append(("d", len(v)))
+            elif isinstance(inner, (bytes, str)):
+                spec.append(("O", len(v)))
+            else:
+                raise ValueError(f"unsupported sequence element: {type(inner)}")
+        else:
+            raise ValueError(f"unsupported column value: {type(v)}")
+    return spec
+
+
+def schema_to_spec(fields, widths=None):
+    """(name, dtype_str) pairs (utils.schema parse output) -> spec."""
+    m = {"bool": "?", "boolean": "?", "int": "i", "integer": "i",
+         "bigint": "l", "long": "l", "float": "f", "double": "d",
+         "string": "O", "binary": "O"}
+    spec = []
+    for i, (name, dt) in enumerate(fields):
+        base = dt
+        width = 0
+        if dt.startswith("array<") and dt.endswith(">"):
+            base = dt[6:-1]
+            width = (widths or {}).get(name, -1)
+        code = m.get(base)
+        if code is None:
+            raise ValueError(f"unsupported schema type {dt} for {name}")
+        spec.append((code, width))
+    return spec
+
+
+def rows_to_columns(rows, spec=None):
+    """Batch of row tuples -> tuple of dense per-column arrays.
+
+    Object ('O') columns always take the numpy path (the native layer
+    handles the numeric matrix; strings/bytes stay python objects, like
+    the reference's byte-string tensors)."""
+    rows = list(rows)
+    if not rows:
+        return ()
+    if spec is None:
+        spec = infer_spec(rows[0])
+    ext = _load_ext()
+    if ext is not None and all(c in _CODE_TO_DTYPE for c, _ in spec):
+        return ext.rows_to_columns(rows, [(c, int(w)) for c, w in spec])
+    # numpy fallback (identical semantics)
+    for i, r in enumerate(rows):
+        if len(r) != len(spec):
+            raise ValueError(
+                f"row {i} has {len(r)} fields, spec has {len(spec)} columns"
+            )
+    out = []
+    for c, (code, width) in enumerate(spec):
+        vals = [r[c] for r in rows]
+        if code == "O":
+            arr = np.empty(len(vals), dtype=object)
+            arr[:] = vals
+        else:
+            arr = np.asarray(vals, dtype=_CODE_TO_DTYPE[code])
+            if width and arr.shape[1:] != (width,):
+                raise ValueError(
+                    f"column {c}: shape {arr.shape[1:]} != width {width}"
+                )
+        out.append(arr)
+    return tuple(out)
+
+
+def columns_to_rows(columns):
+    """Dense per-column arrays -> list of row tuples.
+
+    1-D columns yield python scalars; 2-D columns yield python lists
+    (parity: tensors2batch's scalar-vs-Seq rule, TFModel.scala:121-239).
+    """
+    columns = [np.ascontiguousarray(a) for a in columns]
+    ext = _load_ext()
+    if ext is not None and all(
+        a.dtype.kind in "bif?" and a.ndim in (1, 2) for a in columns
+    ):
+        return ext.columns_to_rows(columns)
+    n = len(columns[0]) if columns else 0
+    cols = []
+    for a in columns:
+        if a.ndim <= 1:
+            cols.append(a.tolist())
+        else:
+            # per-row nested lists; ndim>2 keeps its nesting (the ext path
+            # only handles ndim<=2, so those arrays always land here)
+            cols.append([row.tolist() for row in a])
+    return [tuple(col[i] for col in cols) for i in range(n)]

@@ -1,0 +1,129 @@
+"""pyspark.ml interop: genuine Spark ML pipeline stages over the TPU
+cluster runtime (parity: reference tensorflowonspark/pipeline.py:351-489,
+where TFEstimator/TFModel subclass pyspark.ml.Estimator/Model and compose
+in a ``pyspark.ml.Pipeline``).
+
+Import requires pyspark.  The classes wrap this package's own
+``pipeline.TFEstimator``/``pipeline.TFModel`` (which hold the Params
+machinery, cluster launch, and cached-model inference) and add only the
+Spark ML contract: ``Estimator._fit(DataFrame) -> Model`` and
+``Model._transform(DataFrame) -> DataFrame``.
+
+The reference user surface carries over verbatim::
+
+    from tensorflowonspark_tpu.spark_ml import TFEstimator
+    est = TFEstimator(main_fun, args).setClusterSize(2).setEpochs(1)
+    model = Pipeline(stages=[est]).fit(df)
+    preds = model.transform(df)
+"""
+
+from __future__ import annotations
+
+import logging
+
+from pyspark.ml import Estimator as _SparkEstimator, Model as _SparkModel
+
+from tensorflowonspark_tpu import pipeline as _pipeline
+
+logger = logging.getLogger(__name__)
+
+
+class _DelegatesParams:
+    """Routes the Has* setter/getter surface (setBatchSize, getEpochs, …)
+    and Params introspection to the wrapped implementation object, while
+    keeping ``self`` as the return value of setters so Spark ML style
+    chaining stays on the Spark stage."""
+
+    _impl = None
+
+    def __getattr__(self, name):
+        impl = object.__getattribute__(self, "_impl")
+        if impl is None:
+            raise AttributeError(name)
+        attr = getattr(impl, name)
+        if name.startswith("set") and callable(attr):
+            def chaining_setter(*a, _attr=attr, **kw):
+                _attr(*a, **kw)
+                return self
+
+            return chaining_setter
+        return attr
+
+    # Spark's Params surface, delegated so Pipeline/copy interop works
+    @property
+    def params(self):
+        return self._impl.params
+
+    def extractParamMap(self, extra=None):
+        out = self._impl.extractParamMap()
+        out.update(extra or {})
+        return out
+
+    def getOrDefault(self, param):
+        return self._impl.getOrDefault(param)
+
+    def isDefined(self, param):
+        return self._impl.isDefined(param)
+
+    def copy(self, extra=None):
+        import copy as _copy
+
+        dup = _copy.copy(self)
+        dup._impl = self._impl.copy(
+            {(k.name if hasattr(k, "name") else k): v
+             for k, v in (extra or {}).items()}
+        )
+        return dup
+
+
+class TFEstimator(_DelegatesParams, _SparkEstimator):
+    """pyspark.ml.Estimator that trains via TFCluster on the DataFrame's
+    SparkContext and returns a :class:`TFModel`."""
+
+    def __init__(self, train_fn, tf_args=None, export_fn=None):
+        super().__init__()
+        self._impl = _pipeline.TFEstimator(train_fn, tf_args, export_fn)
+
+    def _fit(self, dataset):
+        model_impl = self._impl.fit(dataset)
+        return TFModel._wrap(model_impl)
+
+
+class TFModel(_DelegatesParams, _SparkModel):
+    """pyspark.ml.Model running cached single-process batch inference per
+    executor; ``transform`` returns a DataFrame of the output_mapping
+    columns (parity: reference pipeline.TFModel + TFModel.scala:245-292)."""
+
+    def __init__(self, tf_args=None):
+        super().__init__()
+        self._impl = _pipeline.TFModel(tf_args)
+
+    @classmethod
+    def _wrap(cls, impl):
+        m = cls.__new__(cls)
+        _SparkModel.__init__(m)
+        m._impl = impl
+        return m
+
+    def _transform(self, dataset):
+        from pyspark.sql import Row, SparkSession
+
+        out_ds = self._impl.transform(dataset)  # SparkDataset of dict rows
+        args = self._impl.merge_args_params()
+        out_cols = (
+            [c for _, c in sorted(args.output_mapping.items())]
+            if getattr(args, "output_mapping", None) else None
+        )
+
+        def _to_rows(it, _cols=tuple(out_cols or ())):
+            rows = []
+            for d in it:
+                cols = list(_cols) if _cols else sorted(d)
+                rows.append(Row(**{c: d[c] for c in cols}))
+            return rows
+
+        row_rdd = out_ds.rdd.mapPartitions(_to_rows)
+        session = getattr(dataset, "sparkSession", None) or (
+            SparkSession.builder.getOrCreate()
+        )
+        return session.createDataFrame(row_rdd, schema=out_cols)

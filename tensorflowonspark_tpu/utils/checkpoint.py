@@ -116,10 +116,98 @@ def _to_host(params):
     return jax.tree_util.tree_map(lambda x: np.asarray(x), params)
 
 
-def async_checkpointer(ckpt_dir):
-    """Orbax async checkpointer for production runs (GCS paths work)."""
-    import orbax.checkpoint as ocp
+def pack_pytree(tree):
+    """Arbitrary pytree (optax states, namedtuples, ...) -> flat
+    {index: ndarray} dict storable by save_checkpoint (npz holds flat
+    arrays; the structure is re-imposed by unpack_pytree at load)."""
+    import jax
 
-    return ocp.CheckpointManager(
-        ckpt_dir, options=ocp.CheckpointManagerOptions(max_to_keep=3)
+    return {
+        f"{i:05d}": np.asarray(x)
+        for i, x in enumerate(jax.tree_util.tree_leaves(tree))
+    }
+
+
+def unpack_pytree(flat, like):
+    """Rebuild a pytree with the structure of ``like`` from pack_pytree
+    output (leaf order is jax's canonical tree order)."""
+    import jax
+
+    leaves = [flat[k] for k in sorted(flat)]
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(like), leaves
     )
+
+
+def step_of(ckpt_path):
+    """Step number encoded in a ``ckpt-<step>.npz`` path."""
+    name = os.path.basename(ckpt_path)
+    return int(name[len("ckpt-"):-len(".npz")])
+
+
+def restore_latest(ckpt_dir):
+    """(params, step) from the newest checkpoint, or (None, 0).
+
+    The resume half of the recovery contract (SURVEY.md §5: recovery is
+    "restart job from checkpoint"): training mains call this at startup
+    and begin from the returned step.
+    """
+    path = latest_checkpoint(ckpt_dir)
+    if path is None:
+        return None, 0
+    logger.info("resuming from %s", path)
+    return load_checkpoint(path), step_of(path)
+
+
+class AsyncCheckpointer:
+    """Orbax-backed async checkpointing (GCS-capable) behind the same
+    save/restore contract as the npz functions: device-to-host copy and
+    serialization overlap training instead of blocking the step loop.
+
+    Usage::
+
+        ckpt = AsyncCheckpointer(model_dir)
+        params, start = ckpt.restore_latest()
+        for step in range(start, steps):
+            ...
+            if step % save_every == 0:
+                ckpt.save(step, params)   # returns immediately
+        ckpt.close()                      # waits for in-flight saves
+    """
+
+    def __init__(self, ckpt_dir, keep=3):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self._mngr = ocp.CheckpointManager(
+            os.path.abspath(ckpt_dir),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep, enable_async_checkpointing=True
+            ),
+        )
+
+    def save(self, step, tree):
+        """Queue an async save of ``tree`` at ``step`` (non-blocking)."""
+        self._mngr.save(step, args=self._ocp.args.StandardSave(tree))
+
+    def latest_step(self):
+        return self._mngr.latest_step()
+
+    def restore_latest(self):
+        """(tree, next_step) — (None, 0) when no checkpoint exists."""
+        step = self._mngr.latest_step()
+        if step is None:
+            return None, 0
+        return self._mngr.restore(step), step
+
+    def wait(self):
+        self._mngr.wait_until_finished()
+
+    def close(self):
+        self._mngr.wait_until_finished()
+        self._mngr.close()
+
+
+def async_checkpointer(ckpt_dir, keep=3):
+    """Back-compat constructor for :class:`AsyncCheckpointer`."""
+    return AsyncCheckpointer(ckpt_dir, keep=keep)

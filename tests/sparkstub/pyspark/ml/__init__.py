@@ -1,0 +1,100 @@
+"""pyspark.ml stand-in: Estimator/Transformer/Model/Pipeline skeletons.
+
+Mirrors the entry-point semantics the compat layer relies on:
+``Estimator.fit(dataset[, params])`` dispatches to ``_fit`` (after
+``copy(params)``), ``Transformer.transform`` to ``_transform``;
+``Pipeline.fit`` walks stages in order, transforming through fitted
+models, and returns a ``PipelineModel``.
+"""
+
+from __future__ import annotations
+
+import uuid
+
+from pyspark.ml.param import Params
+
+
+class Identifiable:
+    def __init__(self):
+        self.uid = f"{type(self).__name__}_{uuid.uuid4().hex[:12]}"
+
+
+class Estimator(Params, Identifiable):
+    def __init__(self):
+        Params.__init__(self)
+        Identifiable.__init__(self)
+
+    def fit(self, dataset, params=None):
+        if params:
+            return self.copy(params)._fit(dataset)
+        return self._fit(dataset)
+
+    def _fit(self, dataset):
+        raise NotImplementedError
+
+
+class Transformer(Params, Identifiable):
+    def __init__(self):
+        Params.__init__(self)
+        Identifiable.__init__(self)
+
+    def transform(self, dataset, params=None):
+        if params:
+            return self.copy(params)._transform(dataset)
+        return self._transform(dataset)
+
+    def _transform(self, dataset):
+        raise NotImplementedError
+
+
+class Model(Transformer):
+    pass
+
+
+class Pipeline(Estimator):
+    def __init__(self, stages=None):
+        super().__init__()
+        self._stages = list(stages or [])
+
+    def setStages(self, stages):
+        self._stages = list(stages)
+        return self
+
+    def getStages(self):
+        return list(self._stages)
+
+    def _fit(self, dataset):
+        # pyspark semantics: intermediate results are only materialized
+        # for stages BEFORE the last Estimator (a trailing estimator's
+        # model is never asked to transform the training data)
+        last_est = max(
+            (i for i, s in enumerate(self._stages) if isinstance(s, Estimator)),
+            default=-1,
+        )
+        transformers = []
+        df = dataset
+        for i, stage in enumerate(self._stages):
+            if isinstance(stage, Estimator):
+                model = stage.fit(df)
+                transformers.append(model)
+                if i < last_est:
+                    df = model.transform(df)
+            elif isinstance(stage, Transformer):
+                transformers.append(stage)
+                if i < last_est:
+                    df = stage.transform(df)
+            else:
+                raise TypeError(f"pipeline stage is not Estimator/Transformer: {stage!r}")
+        return PipelineModel(transformers)
+
+
+class PipelineModel(Model):
+    def __init__(self, stages):
+        super().__init__()
+        self.stages = list(stages)
+
+    def _transform(self, dataset):
+        df = dataset
+        for stage in self.stages:
+            df = stage.transform(df)
+        return df

@@ -1,0 +1,95 @@
+"""pyspark.ml.param stand-in: Param/Params with the pyspark surface."""
+
+from __future__ import annotations
+
+import copy as _copy
+
+
+class Param:
+    def __init__(self, parent, name, doc, typeConverter=None):
+        self.parent = parent
+        self.name = name
+        self.doc = doc
+        self.typeConverter = typeConverter
+
+    def __repr__(self):
+        return f"Param({self.name})"
+
+
+class TypeConverters:
+    toInt = staticmethod(int)
+    toFloat = staticmethod(float)
+    toString = staticmethod(str)
+
+    @staticmethod
+    def toBoolean(v):
+        if isinstance(v, str):
+            return v.lower() in ("1", "true", "yes")
+        return bool(v)
+
+    @staticmethod
+    def identity(v):
+        return v
+
+
+class Params:
+    def __init__(self):
+        self._paramMap = {}
+        self._defaultParamMap = {}
+
+    @property
+    def params(self):
+        out = []
+        for klass in type(self).__mro__:
+            for val in vars(klass).values():
+                if isinstance(val, Param):
+                    out.append(val)
+        return out
+
+    def _resolveParam(self, param):
+        if isinstance(param, Param):
+            return param
+        for p in self.params:
+            if p.name == param:
+                return p
+        raise KeyError(f"no param {param}")
+
+    def _set(self, **kwargs):
+        for name, value in kwargs.items():
+            p = self._resolveParam(name)
+            if value is not None and p.typeConverter is not None:
+                value = p.typeConverter(value)
+            self._paramMap[p] = value
+        return self
+
+    def _setDefault(self, **kwargs):
+        for name, value in kwargs.items():
+            self._defaultParamMap[self._resolveParam(name)] = value
+        return self
+
+    def isDefined(self, param):
+        p = self._resolveParam(param)
+        return p in self._paramMap or p in self._defaultParamMap
+
+    def hasDefault(self, param):
+        return self._resolveParam(param) in self._defaultParamMap
+
+    def getOrDefault(self, param):
+        p = self._resolveParam(param)
+        if p in self._paramMap:
+            return self._paramMap[p]
+        return self._defaultParamMap[p]
+
+    def extractParamMap(self, extra=None):
+        out = dict(self._defaultParamMap)
+        out.update(self._paramMap)
+        out.update(extra or {})
+        return out
+
+    def copy(self, extra=None):
+        dup = _copy.copy(self)
+        dup._paramMap = dict(self._paramMap)
+        dup._defaultParamMap = dict(self._defaultParamMap)
+        for key, value in (extra or {}).items():
+            dup._set(**{key.name if isinstance(key, Param) else key: value})
+        return dup

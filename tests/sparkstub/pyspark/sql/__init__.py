@@ -1,0 +1,138 @@
+"""pyspark.sql stand-in: Row, DataFrame, SparkSession (see package doc)."""
+
+from __future__ import annotations
+
+import pyspark as _ps
+
+
+class Row(tuple):
+    """Tuple with named fields (parity: pyspark.sql.Row)."""
+
+    def __new__(cls, **kwargs):
+        row = super().__new__(cls, tuple(kwargs.values()))
+        row.__fields__ = list(kwargs)
+        return row
+
+    def asDict(self):
+        return dict(zip(self.__fields__, self))
+
+    def __getattr__(self, name):
+        try:
+            return self[self.__fields__.index(name)]
+        except (ValueError, AttributeError):
+            raise AttributeError(name) from None
+
+    def __reduce__(self):
+        return (_row_from_pairs, (self.__fields__, tuple(self)))
+
+    def __repr__(self):
+        return "Row(%s)" % ", ".join(
+            f"{k}={v!r}" for k, v in zip(self.__fields__, self)
+        )
+
+
+def _row_from_pairs(fields, values):
+    return Row(**dict(zip(fields, values)))
+
+
+class DataFrame:
+    def __init__(self, rdd, columns, session):
+        self._row_rdd = rdd  # RDD of Row
+        self.columns = list(columns)
+        self.sparkSession = session
+
+    @property
+    def rdd(self):
+        return self._row_rdd
+
+    def select(self, *cols):
+        if len(cols) == 1 and isinstance(cols[0], (list, tuple)):
+            cols = list(cols[0])
+        cols = list(cols)
+
+        def _project(it, _cols=tuple(cols)):
+            return [
+                _row_from_pairs(list(_cols), tuple(r.asDict()[c] for c in _cols))
+                for r in it
+            ]
+
+        return DataFrame(self._row_rdd.mapPartitions(_project), cols,
+                         self.sparkSession)
+
+    def collect(self):
+        return self._row_rdd.collect()
+
+    def count(self):
+        return self._row_rdd.count()
+
+
+class _Builder:
+    def __init__(self):
+        self._conf = _ps.SparkConf()
+
+    def master(self, m):
+        self._conf.setMaster(m)
+        return self
+
+    def appName(self, n):
+        self._conf.setAppName(n)
+        return self
+
+    def config(self, key, value):
+        self._conf.set(key, value)
+        return self
+
+    def getOrCreate(self):
+        sc = _ps.SparkContext.getOrCreate(self._conf)
+        return SparkSession(sc)
+
+
+class SparkSession:
+    def __init__(self, sc):
+        self.sparkContext = sc
+
+    builder = None  # class-level property installed below
+
+    def createDataFrame(self, data, schema=None):
+        """data: list of tuples/dicts/Rows, or an RDD of Rows; schema: list
+        of column names (the subset of createDataFrame this project uses)."""
+        if isinstance(data, _ps.RDD):
+            first = data.collect()[:1]
+            if not first:
+                raise ValueError("cannot infer schema from empty RDD")
+            cols = schema or list(first[0].__fields__)
+            rdd = data.mapPartitions(
+                lambda it, _c=tuple(cols): [
+                    r if isinstance(r, Row)
+                    else _row_from_pairs(list(_c), tuple(r))
+                    for r in it
+                ]
+            )
+            return DataFrame(rdd, cols, self)
+        rows = []
+        cols = list(schema) if schema else None
+        for item in data:
+            if isinstance(item, Row):
+                if cols is None:
+                    cols = list(item.__fields__)
+                rows.append(item)
+            elif isinstance(item, dict):
+                if cols is None:
+                    cols = list(item)
+                rows.append(_row_from_pairs(cols, tuple(item[c] for c in cols)))
+            else:
+                assert cols is not None, "schema required for tuple rows"
+                rows.append(_row_from_pairs(cols, tuple(item)))
+        rdd = self.sparkContext.parallelize(rows)
+        return DataFrame(rdd, cols, self)
+
+    def stop(self):
+        self.sparkContext.stop()
+
+
+class _BuilderDescriptor:
+    def __get__(self, obj, objtype=None):
+        return _Builder()
+
+
+SparkSession.builder = _BuilderDescriptor()

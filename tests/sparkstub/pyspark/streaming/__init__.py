@@ -1,0 +1,92 @@
+"""pyspark.streaming stand-in: StreamingContext + DStream via queueStream.
+
+Micro-batch loop semantics mirrored from Spark Streaming: ``start()``
+launches a driver-side thread that, every ``batchDuration`` seconds,
+takes the next RDD from each queue stream and invokes the registered
+``foreachRDD`` callbacks; ``awaitTerminationOrTimeout`` blocks up to the
+timeout and returns True once the context stopped; ``stop(...,
+stopGraceFully=True)`` lets the in-flight batch finish first.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class DStream:
+    def __init__(self, ssc, rdd_queue, oneAtATime=True, default=None):
+        self._ssc = ssc
+        self._queue = list(rdd_queue)
+        self._one = oneAtATime
+        self._default = default
+        self._callbacks = []
+
+    def foreachRDD(self, func):
+        self._callbacks.append(func)
+
+    def _next_rdd(self):
+        if self._queue:
+            return self._queue.pop(0) if self._one else self._queue[-1]
+        return self._default
+
+    def _tick(self, batch_time):
+        rdd = self._next_rdd()
+        if rdd is None:
+            return
+        for cb in self._callbacks:
+            try:
+                cb(batch_time, rdd)
+            except TypeError:
+                cb(rdd)
+
+
+class StreamingContext:
+    def __init__(self, sparkContext, batchDuration=1):
+        self.sparkContext = sparkContext
+        self._duration = batchDuration
+        self._streams = []
+        self._stopped = threading.Event()
+        self._thread = None
+
+    def queueStream(self, rdds, oneAtATime=True, default=None):
+        ds = DStream(self, rdds, oneAtATime, default)
+        self._streams.append(ds)
+        return ds
+
+    def start(self):
+        assert self._thread is None, "StreamingContext already started"
+
+        def _loop():
+            while not self._stopped.is_set():
+                t = time.time()
+                for ds in self._streams:
+                    if self._stopped.is_set():
+                        break
+                    ds._tick(t)
+                self._stopped.wait(self._duration)
+
+        self._thread = threading.Thread(
+            target=_loop, name="stub-streaming", daemon=True
+        )
+        self._thread.start()
+
+    def awaitTerminationOrTimeout(self, timeout):
+        """True if the context terminated within ``timeout`` seconds."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self._stopped.is_set() and (
+                self._thread is None or not self._thread.is_alive()
+            ):
+                return True
+            time.sleep(0.05)
+        return self._stopped.is_set() and (
+            self._thread is None or not self._thread.is_alive()
+        )
+
+    def stop(self, stopSparkContext=True, stopGraceFully=False):
+        self._stopped.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30 if stopGraceFully else 5)
+        if stopSparkContext:
+            self.sparkContext.stop()

