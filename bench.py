@@ -33,7 +33,203 @@ def _peak_flops(device):
     return 197e12  # default: v5e
 
 
+FED_CHUNK = 64  # records per shm-ring chunk (node.FEED_CHUNK_RECORDS scale)
+
+
+def _feeder_main(ring_name, mgr_addr, authkey_hex, total_records, image, pool=16):
+    """Feeder child (no jax): generate (uint8 image, label) records and push
+    chunks through the shm ring exactly like node.train's feeder closure."""
+    import numpy as np
+
+    from tensorflowonspark_tpu import manager as tfmanager
+    from tensorflowonspark_tpu.recordio import shm as shmq
+
+    mgr = tfmanager.connect(tuple(mgr_addr), bytes.fromhex(authkey_hex))
+    ring = shmq.ShmQueue(ring_name, create=False, producer=True)
+    rng = np.random.default_rng(0)
+    images = [rng.integers(0, 256, (image, image, 3), dtype=np.uint8)
+              for _ in range(pool)]
+    sent = 0
+    chunk = []
+    while sent < total_records:
+        chunk.append((images[sent % pool], sent % 1000))
+        sent += 1
+        if len(chunk) >= FED_CHUNK:
+            ring.put(chunk)
+            chunk = []
+    if chunk:
+        ring.put(chunk)
+    ring.put(None)  # end-of-feed marker
+    ring.close()
+    mgr.set("feeder_done", 1)
+
+
+def _fed_setup(batch, image, steps):
+    """Pre-jax setup of the fed pipeline: IPC manager + shm ring + a real
+    feeder process.  Must run before jax/the TPU tunnel initializes in
+    this process: the feeder child is spawned with PYTHONPATH cleared so
+    the axon site hook never runs in it, and the manager server is forked
+    before any accelerator state exists."""
+    import multiprocessing as mp
+    import secrets
+
+    from tensorflowonspark_tpu import manager as tfmanager
+    from tensorflowonspark_tpu.recordio import shm as shmq
+
+    if not shmq.available():
+        return None
+    authkey = secrets.token_bytes(16)
+    mgr = tfmanager.start(authkey, ["input", "output", "error", "control"])
+    ring_name = f"/tfos-bench-{os.getpid():x}"
+    # modest capacity on purpose: a huge ring would let the feeder run
+    # steps ahead during compile and overstate steady-state throughput
+    ring = shmq.ShmQueue(ring_name, 64 << 20, create=True)
+    mgr.set("shm_input", ring_name)
+    total = (steps + 2) * batch  # +2 warmup batches
+    ctx = mp.get_context("spawn")
+    saved = os.environ.get("PYTHONPATH")
+    os.environ["PYTHONPATH"] = ""
+    try:
+        proc = ctx.Process(
+            target=_feeder_main,
+            args=(ring_name, list(mgr.address), authkey.hex(), total, image),
+            daemon=True,
+        )
+        proc.start()
+    finally:
+        if saved is None:
+            os.environ.pop("PYTHONPATH", None)
+        else:
+            os.environ["PYTHONPATH"] = saved
+    return {"mgr": mgr, "ring": ring, "proc": proc, "steps": steps,
+            "batch": batch, "image": image}
+
+
+def _fed_run(fed, step_fn, params, state, opt_state):
+    """Train from the fed pipeline on the device; report fed throughput,
+    infeed stall, and the device-resident per-dispatch comparator."""
+    import jax
+    import numpy as np
+
+    from tensorflowonspark_tpu.feed import DataFeed
+    from tensorflowonspark_tpu.infeed import device_feed
+    from tensorflowonspark_tpu.utils.metrics import TrainMetrics
+
+    batch, image, steps = fed["batch"], fed["image"], fed["steps"]
+    fed_step = jax.jit(step_fn, donate_argnums=(0, 1, 2))
+
+    # comparator: same per-dispatch step loop on a device-resident batch
+    rng = np.random.default_rng(0)
+    res_imgs = jax.device_put(
+        rng.integers(0, 256, (batch, image, image, 3), dtype=np.uint8)
+    )
+    res_labels = jax.device_put(rng.integers(0, 1000, batch).astype(np.int32))
+    p, s, o = params, state, opt_state
+    p, s, o, loss, _ = fed_step(p, s, o, res_imgs, res_labels)  # compile
+    loss.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        p, s, o, loss, _ = fed_step(p, s, o, res_imgs, res_labels)
+    loss.block_until_ready()
+    loop_ips = batch * steps / (time.perf_counter() - t0)
+
+    metrics = TrainMetrics()
+    feed = DataFeed(fed["mgr"], train_mode=True,
+                    input_mapping={"image": "image", "label": "label"},
+                    metrics=metrics)
+
+    # watchdog: a feeder that dies without pushing the end-of-feed None
+    # would block the consumer forever — unblock it by closing the feed
+    import threading
+
+    stop_watch = threading.Event()
+
+    def watchdog():
+        fed["proc"].join()
+        if fed["proc"].exitcode not in (0, None) and not stop_watch.is_set():
+            import sys
+
+            from tensorflowonspark_tpu.recordio import shm as shmq
+
+            print(f"bench: feeder died rc={fed['proc'].exitcode}, "
+                  "closing feed", file=sys.stderr, flush=True)
+            try:
+                shmq.ShmQueue(fed["ring"].name, create=False,
+                              producer=True).put(None)
+            except Exception:  # noqa: BLE001 - consumer may already be done
+                pass
+
+    threading.Thread(target=watchdog, daemon=True).start()
+
+    def collate(cols):
+        return np.stack(cols["image"]), np.asarray(cols["label"], np.int32)
+
+    nsteps = 0
+    n_timed = 0
+    t0 = None
+    wait_base = 0.0
+    last = None
+    for imgs, labels in device_feed(feed, batch, collate=collate, depth=2):
+        p, s, o, last, _ = fed_step(p, s, o, imgs, labels)
+        nsteps += 1
+        if nsteps == 1:
+            last.block_until_ready()  # absorb any warmup/compile skew
+            t0 = time.perf_counter()
+            wait_base = metrics.infeed_time  # align stall window with dt
+        else:
+            n_timed += 1
+    stop_watch.set()
+    if last is None or n_timed == 0:  # feeder died before one full batch
+        rc = fed["proc"].exitcode
+        fed["mgr"].set("state", "stopped")
+        fed["ring"].close()
+        return {"error": f"no fed batches completed (feeder exitcode={rc})"}
+    last.block_until_ready()
+    dt = time.perf_counter() - t0
+    fed_ips = batch * n_timed / dt
+    stall = max(metrics.infeed_time - wait_base, 0.0)
+
+    fed["proc"].join(timeout=10)
+    if fed["proc"].is_alive():
+        fed["proc"].kill()
+    fed["mgr"].set("state", "stopped")
+    fed["ring"].close()
+
+    return {
+        "images_per_sec_per_chip": round(fed_ips, 1),
+        "loop_images_per_sec": round(loop_ips, 1),
+        "vs_device_resident": round(fed_ips / loop_ips, 4) if loop_ips else None,
+        "infeed_wait_s": round(stall, 3),
+        "infeed_stall_frac": round(stall / dt, 4) if dt else None,
+        "steps": n_timed, "chunk_records": FED_CHUNK,
+    }
+
+
+def _on_tpu_guess():
+    """Pre-jax platform guess (the fed pipeline must be set up before the
+    TPU tunnel initializes in this process).  Chip discovery delegates to
+    tpu_info (stdlib-only import, honors TFOS_TPU_CHIPS_PER_HOST)."""
+    from tensorflowonspark_tpu import tpu_info
+
+    plat = os.environ.get("JAX_PLATFORMS", "").lower()
+    if plat in ("cpu",):
+        return False
+    return bool(plat) or tpu_info.count_chips() > 0
+
+
 def main():
+    on_tpu = _on_tpu_guess()
+    batch = int(os.environ.get("TFOS_BENCH_BATCH", "256" if on_tpu else "16"))
+    image = int(os.environ.get("TFOS_BENCH_IMAGE", "224" if on_tpu else "64"))
+    steps = int(os.environ.get("TFOS_BENCH_STEPS", "20" if on_tpu else "3"))
+
+    fed_ctx = None
+    if os.environ.get("TFOS_BENCH_FED", "1") != "0":
+        try:
+            fed_ctx = _fed_setup(batch, image, steps)
+        except Exception as e:  # noqa: BLE001 - fed lane is best-effort
+            fed_ctx = {"setup_error": str(e)[:200]}
+
     import jax
     import jax.numpy as jnp
     import optax
@@ -41,16 +237,27 @@ def main():
     from tensorflowonspark_tpu.models import resnet
 
     dev = jax.devices()[0]
+    guessed_tpu = on_tpu
     on_tpu = dev.platform != "cpu"
-    batch = int(os.environ.get("TFOS_BENCH_BATCH", "256" if on_tpu else "16"))
-    image = int(os.environ.get("TFOS_BENCH_IMAGE", "224" if on_tpu else "64"))
-    steps = int(os.environ.get("TFOS_BENCH_STEPS", "20" if on_tpu else "3"))
+    if on_tpu != guessed_tpu:
+        import sys
+
+        print(f"bench: platform guess ({guessed_tpu}) != actual "
+              f"({dev.platform}); workload sized from the guess",
+              file=sys.stderr, flush=True)
 
     from jax import lax
 
-    params, state = resnet.init(jax.random.PRNGKey(0), depth=50, num_classes=1000)
+    # init under one jit program: eager init is hundreds of tiny
+    # dispatches — minutes of wall time over a remote-compile TPU tunnel
     opt = optax.sgd(0.1, momentum=0.9)
-    opt_state = opt.init(params)
+
+    @jax.jit
+    def init_all(key):
+        params, state = resnet.init(key, depth=50, num_classes=1000)
+        return params, state, opt.init(params)
+
+    params, state, opt_state = init_all(jax.random.PRNGKey(0))
     step_fn = resnet.make_train_step(opt, depth=50)
 
     rng = np.random.default_rng(0)
@@ -91,6 +298,19 @@ def main():
         "device": str(dev), "platform": dev.platform,
         "loss": loss,
     }
+    if on_tpu != guessed_tpu:
+        extra["platform_guess_mismatch"] = True
+    if fed_ctx is not None:
+        # the north-star metric is *fed* (InputMode.SPARK-ingestion)
+        # throughput: feeder process -> shm ring -> DataFeed -> device
+        if "setup_error" in fed_ctx:
+            extra["fed"] = fed_ctx
+        else:
+            try:
+                extra["fed"] = _fed_run(fed_ctx, step_fn, params, state, opt_state)
+            except Exception as e:  # noqa: BLE001 - report, don't mask resnet
+                extra["fed"] = {"error": str(e)[:200]}
+
     if os.environ.get("TFOS_BENCH_TRANSFORMER", "1") != "0":
         try:
             extra["transformer"] = _transformer_bench(dev, on_tpu)
@@ -132,9 +352,14 @@ def _transformer_bench(dev, on_tpu):
         )
         batch, steps = 2, 3
 
-    params = transformer.init(jax.random.PRNGKey(0), cfg)
     opt = optax.adam(1e-3)
-    opt_state = opt.init(params)
+
+    @jax.jit
+    def init_all(key):
+        params = transformer.init(key, cfg)
+        return params, opt.init(params)
+
+    params, opt_state = init_all(jax.random.PRNGKey(0))
     tokens = jnp.asarray(
         np.random.default_rng(0).integers(0, cfg.vocab_size,
                                           (batch, cfg.max_seq)),

@@ -51,14 +51,28 @@ static PyObject *make_array(Py_ssize_t n, Py_ssize_t width, char code) {
 static int fill_value(char code, char *dst, Py_ssize_t idx, PyObject *v) {
   switch (code) {
     case '?': {
+      /* only genuine bools (python bool or numpy.bool_): truthiness of
+       * an int/float here would be a silent lossy cast (2 -> True) the
+       * row path never performs */
+      const char *tn = Py_TYPE(v)->tp_name;
+      if (!PyBool_Check(v) && strcmp(tn, "numpy.bool_") != 0 &&
+          strcmp(tn, "numpy.bool") != 0) {
+        PyErr_SetString(PyExc_TypeError, "bool column requires bool values");
+        return -1;
+      }
       int b = PyObject_IsTrue(v);
       if (b < 0) return -1;
       ((unsigned char *)dst)[idx] = (unsigned char)b;
       return 0;
     }
     case 'i': {
-      long x = PyLong_AsLong(v);
+      long long x = PyLong_AsLongLong(v);
       if (x == -1 && PyErr_Occurred()) return -1;
+      if (x > 2147483647LL || x < -2147483648LL) {
+        PyErr_SetString(PyExc_OverflowError,
+                        "value overflows the int32 column spec");
+        return -1;
+      }
       ((int *)dst)[idx] = (int)x;
       return 0;
     }

@@ -160,6 +160,73 @@ int tfr_reader_close(TFRReader* r) {
 }
 
 // ---------------------------------------------------------------------------
+// Memory-buffer variants: same framing over bytes owned by the caller.
+// Lets Python stream remote objects (gs://, hdfs://, s3://) through
+// fsspec while this library still does all framing + crc work.
+// ---------------------------------------------------------------------------
+
+struct TFRMemWriter {
+  std::string out;
+};
+
+TFRMemWriter* tfr_mem_writer_new() { return new TFRMemWriter(); }
+
+int tfr_mem_writer_write(TFRMemWriter* w, const uint8_t* data, uint64_t len) {
+  uint8_t header[12];
+  memcpy(header, &len, 8);
+  uint32_t lcrc = masked_crc(header, 8);
+  memcpy(header + 8, &lcrc, 4);
+  w->out.append((const char*)header, 12);
+  if (len) w->out.append((const char*)data, len);
+  uint32_t dcrc = masked_crc(data, len);
+  w->out.append((const char*)&dcrc, 4);
+  return 0;
+}
+
+// Buffer valid until the next write/free; *n receives the size.
+const uint8_t* tfr_mem_writer_data(TFRMemWriter* w, uint64_t* n) {
+  *n = w->out.size();
+  return (const uint8_t*)w->out.data();
+}
+
+void tfr_mem_writer_clear(TFRMemWriter* w) { w->out.clear(); }
+
+void tfr_mem_writer_free(TFRMemWriter* w) { delete w; }
+
+struct TFRMemReader {
+  const uint8_t* data;  // caller-owned; must outlive the reader
+  uint64_t len;
+  uint64_t pos;
+};
+
+TFRMemReader* tfr_mem_reader_new(const uint8_t* data, uint64_t len) {
+  return new TFRMemReader{data, len, 0};
+}
+
+// Same contract as tfr_reader_next; *out points into the caller's buffer.
+int64_t tfr_mem_reader_next(TFRMemReader* r, const uint8_t** out) {
+  if (r->pos == r->len) return -1;  // clean EOF
+  if (r->len - r->pos < 12) return -2;
+  const uint8_t* header = r->data + r->pos;
+  uint64_t len;
+  memcpy(&len, header, 8);
+  uint32_t lcrc;
+  memcpy(&lcrc, header + 8, 4);
+  if (masked_crc(header, 8) != lcrc) return -3;
+  if (len > (1ull << 34)) return -4;
+  if (r->len - r->pos - 12 < len + 4) return -5;
+  const uint8_t* body = header + 12;
+  uint32_t dcrc;
+  memcpy(&dcrc, body + len, 4);
+  if (masked_crc(body, len) != dcrc) return -7;
+  r->pos += 12 + len + 4;
+  *out = body;
+  return (int64_t)len;
+}
+
+void tfr_mem_reader_free(TFRMemReader* r) { delete r; }
+
+// ---------------------------------------------------------------------------
 // Proto wire helpers
 // ---------------------------------------------------------------------------
 

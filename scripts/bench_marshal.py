@@ -1,0 +1,101 @@
+"""Measure native (_tfos_marshal) vs numpy row<->column marshalling.
+
+Round-1/2 'done' criterion for native/marshal.c: a measured speedup over
+the numpy path on a realistic batch (parity target: the reference's JVM
+batch2tensors/tensors2batch, TFModel.scala:51-239, whose point is keeping
+per-record conversion out of interpreted code).
+
+Usage: python scripts/bench_marshal.py [--rows N] [--reps N]
+Prints one table; no jax / no TPU involved.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tensorflowonspark_tpu.recordio import marshal  # noqa: E402
+
+
+def timeit(fn, reps):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def bench(name, rows, spec, reps):
+    ext = marshal._load_ext()
+    assert ext is not None, "native extension missing"
+
+    native_cols = None
+
+    def run_native():
+        nonlocal native_cols
+        native_cols = ext.rows_to_columns(rows, [(c, int(w)) for c, w in spec])
+
+    def run_numpy():
+        out = []
+        for c, (code, width) in enumerate(spec):
+            vals = [r[c] for r in rows]
+            out.append(np.asarray(vals, dtype=marshal._CODE_TO_DTYPE[code]))
+        return tuple(out)
+
+    t_nat = timeit(run_native, reps)
+    t_np = timeit(run_numpy, reps)
+
+    cols = native_cols
+    t_nat_back = timeit(lambda: ext.columns_to_rows(list(cols)), reps)
+
+    def back_numpy():
+        lists = [a.tolist() if a.ndim <= 1 else [r.tolist() for r in a]
+                 for a in cols]
+        return [tuple(col[i] for col in lists) for i in range(len(rows))]
+
+    t_np_back = timeit(back_numpy, reps)
+
+    print(f"{name:34s} rows->cols  native {t_nat*1e3:7.2f}ms  "
+          f"numpy {t_np*1e3:7.2f}ms  speedup {t_np/t_nat:5.2f}x")
+    print(f"{'':34s} cols->rows  native {t_nat_back*1e3:7.2f}ms  "
+          f"numpy {t_np_back*1e3:7.2f}ms  speedup {t_np_back/t_nat_back:5.2f}x")
+    return t_np / t_nat, t_np_back / t_nat_back
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=4096)
+    ap.add_argument("--reps", type=int, default=7)
+    args = ap.parse_args()
+    rng = np.random.default_rng(0)
+    n = args.rows
+
+    # MNIST-pipeline shape: 784-wide float features + int label
+    # (reference test_pipeline.py / mnist_spark.py feed rows)
+    mnist = [(list(map(float, rng.random(784))), int(rng.integers(10)))
+             for _ in range(n)]
+    s1 = bench("mnist rows (784f list + label)", mnist,
+               [("f", 784), ("l", 0)], args.reps)
+
+    # scalar-heavy row: 14 mixed scalar columns (TFModel TestData shape)
+    scal = [tuple([bool(i % 2)] + [int(i)] * 6 + [float(i)] * 7)
+            for i in range(n)]
+    s2 = bench("scalar rows (14 mixed cols)", scal,
+               [("?", 0)] + [("l", 0)] * 6 + [("d", 0)] * 7, args.reps)
+
+    # inference batch: 64-wide double vectors
+    infer = [(list(map(float, rng.random(64))),) for _ in range(n)]
+    s3 = bench("vector rows (64d list)", infer, [("d", 64)], args.reps)
+
+    worst = min(s1 + s2 + s3)
+    print(f"\nworst-case native speedup: {worst:.2f}x "
+          f"({'WIN' if worst > 1 else 'LOSS'})")
+
+
+if __name__ == "__main__":
+    main()

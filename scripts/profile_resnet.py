@@ -60,9 +60,17 @@ def main():
 
     from tensorflowonspark_tpu.models import resnet
 
-    params, state = resnet.init(jax.random.PRNGKey(0), depth=50, num_classes=1000)
+    # one jitted init program: eager init is hundreds of tiny dispatches,
+    # intolerably slow over a remote-compile TPU tunnel
+    print("init...", flush=True)
     opt = optax.sgd(0.1, momentum=0.9)
-    opt_state = opt.init(params)
+
+    @jax.jit
+    def init_all(key):
+        params, state = resnet.init(key, depth=50, num_classes=1000)
+        return params, state, opt.init(params)
+
+    params, state, opt_state = init_all(jax.random.PRNGKey(0))
     step_fn = resnet.make_train_step(opt, depth=50)
 
     rng = np.random.default_rng(0)

@@ -18,10 +18,10 @@ Type mapping (dfutil.py:84-131 / DFUtil.scala:195-258 dtype matrix):
 from __future__ import annotations
 
 import logging
-import os
 
 from tensorflowonspark_tpu import recordio
 from tensorflowonspark_tpu.engine import LocalDataset, as_dataset
+from tensorflowonspark_tpu.recordio import fs as _fs
 
 logger = logging.getLogger(__name__)
 
@@ -97,27 +97,31 @@ def from_example(example_bytes: bytes, schema=None, binary_features=()) -> dict:
 # -- save / load -------------------------------------------------------------
 
 def save_as_tfrecords(dataset_or_rows, output_dir):
-    """Write rows as sharded TFRecord files (parity: dfutil.saveAsTFRecords
-    :29-41 — one part file per partition)."""
-    os.makedirs(output_dir, exist_ok=True)
+    """Write rows as sharded TFRecord files on any filesystem — local,
+    gs://, hdfs://, ... via fsspec (parity: dfutil.saveAsTFRecords :29-41,
+    which writes through the Hadoop OutputFormat — one part file per
+    partition)."""
+    _fs.makedirs(output_dir)
     try:
         ds = as_dataset(dataset_or_rows)
     except TypeError:
         ds = None
     if ds is None:
-        _write_shard(dataset_or_rows, os.path.join(output_dir, "part-r-00000"))
+        _write_shard(dataset_or_rows, _fs.join(output_dir, "part-r-00000"))
         return output_dir
 
     def write_partition(it):
         import os as _os
         import uuid as _uuid
 
+        from tensorflowonspark_tpu.recordio import fs as _ffs
+
         rows = list(it)
         if not rows:
             return []
         # unique per partition even when one executor writes several
         # shards back to back (id()-based names can repeat after reuse)
-        shard = _os.path.join(
+        shard = _ffs.join(
             output_dir, f"part-r-{_os.getpid()}-{_uuid.uuid4().hex[:8]}"
         )
         _write_shard(rows, shard)
@@ -142,10 +146,10 @@ def load_tfrecords(source, input_dir, binary_features=()):
     the shard list; pass None for a plain list of rows.
     """
     files = sorted(
-        os.path.join(input_dir, f)
-        for f in os.listdir(input_dir)
+        _fs.join(input_dir, f)
+        for f in _fs.listdir(input_dir)
         if f.startswith("part-") and not f.endswith(".tmp")
-    ) if os.path.isdir(input_dir) else [input_dir]
+    ) if _fs.isdir(input_dir) else [input_dir]
     if not files:
         raise FileNotFoundError(f"no TFRecord part files under {input_dir}")
 

@@ -62,26 +62,46 @@ def _executor_main(index, workdir, shared_inbox, own_inbox, results):
     """Executor process loop: pull a task, run it, report the result."""
     os.chdir(workdir)
     os.environ["TFOS_EXECUTOR_INDEX"] = str(index)
-    while True:
-        msg = None
-        # Prefer directly-assigned tasks; otherwise steal from the pool.
-        try:
-            msg = own_inbox.get(timeout=0.02)
-        except _queue.Empty:
+    try:
+        while True:
+            msg = None
+            # Prefer directly-assigned tasks; otherwise steal from the pool.
             try:
-                msg = shared_inbox.get(timeout=0.02)
+                msg = own_inbox.get(timeout=0.02)
             except _queue.Empty:
-                continue
-        if msg[0] == "stop":
-            break
-        _, job_id, task_id, blob = msg
+                try:
+                    msg = shared_inbox.get(timeout=0.02)
+                except _queue.Empty:
+                    continue
+            if msg[0] == "stop":
+                break
+            _, job_id, task_id, blob = msg
+            try:
+                fn, items, collect = cloudpickle.loads(blob)
+                out = fn(iter(items))
+                result = list(out) if (collect and out is not None) else None
+                results.put(("ok", job_id, task_id, index, result))
+            except BaseException:  # noqa: BLE001 - must report any task failure
+                results.put(("error", job_id, task_id, index, traceback.format_exc()))
+    finally:
+        _reap_executor_children()
+
+
+def _reap_executor_children():
+    """Terminate and collect every live child of this executor before the
+    interpreter exits.  A background trainer left behind by a crashed run
+    would otherwise (a) block multiprocessing's atexit join forever (it is
+    non-daemonic) and (b) hold the resource-tracker pipe open, wedging the
+    *driver* interpreter's exit too."""
+    for child in mp.active_children():
         try:
-            fn, items, collect = cloudpickle.loads(blob)
-            out = fn(iter(items))
-            result = list(out) if (collect and out is not None) else None
-            results.put(("ok", job_id, task_id, index, result))
-        except BaseException:  # noqa: BLE001 - must report any task failure
-            results.put(("error", job_id, task_id, index, traceback.format_exc()))
+            child.terminate()
+            child.join(timeout=3)
+            if child.is_alive():
+                child.kill()
+                child.join(timeout=2)
+        except (OSError, ValueError, AssertionError):
+            pass
 
 
 # ----------------------------------------------------------------------------
@@ -363,6 +383,22 @@ class LocalEngine:
             p.join(timeout=max(0.1, deadline - time.time()))
             if p.is_alive():
                 p.terminate()
+                p.join(timeout=2)
+            if p.is_alive():
+                p.kill()
+                p.join(timeout=2)
+        # Executors killed un-gracefully may leave their forked children
+        # (background trainer, IPC-manager server) re-parented to init;
+        # each executor recorded those pids in its working dir — kill any
+        # survivor so nothing outlives the engine (and nothing keeps the
+        # resource-tracker pipe open past interpreter exit).
+        from tensorflowonspark_tpu.utils import kill_pid, read_child_pids
+
+        for d in self.executor_dirs:
+            for pid in read_child_pids(d):
+                if kill_pid(pid, 0):  # still alive
+                    logger.warning("stop: killing leftover child pid %d", pid)
+                    kill_pid(pid)
         if self._owns_root:
             shutil.rmtree(self._root, ignore_errors=True)
 

@@ -98,6 +98,7 @@ class DataFeed:
             sorted(input_mapping.values()) if input_mapping is not None else None
         )
         self._buffer = []  # leftover records from a partially-consumed chunk
+        self._colblock = None  # (ColumnChunk, offset): partially-consumed
         # shm fast path; the handshake (open_feed_ring) is shared with the
         # producer closures so both sides always agree on the transport
         self._ring = open_feed_ring(mgr, qname_in, producer=False)
@@ -138,9 +139,36 @@ class DataFeed:
                     tensors[t].append(record[i])
             count += 1
 
+        def _take_columns(block):
+            """Consume up to the batch remainder from a columnar chunk.
+
+            With input_mapping, column slices extend the per-tensor lists
+            directly — no per-record python loop (scalar columns extend
+            with numpy scalars, width columns with row views, both of
+            which np.asarray/np.stack handle in one memcpy downstream).
+            """
+            nonlocal count
+            chunk, off = block
+            take = min(batch_size - count, len(chunk) - off)
+            if self.input_tensors is None:
+                from tensorflowonspark_tpu.recordio import marshal
+
+                self._buffer.extend(marshal.columns_to_rows(
+                    [c[off:off + take] for c in chunk.columns]
+                ))
+            else:
+                for i, t in enumerate(self.input_tensors):
+                    tensors[t].extend(chunk.columns[i][off:off + take])
+                count += take
+            off += take
+            return (chunk, off) if off < len(chunk) else None
+
         while count < batch_size:
             if self._buffer:
                 _append(self._buffer.pop(0))
+                continue
+            if self._colblock is not None:
+                self._colblock = _take_columns(self._colblock)
                 continue
             chunk = self._get_chunk()
             if chunk is None:
@@ -151,6 +179,9 @@ class DataFeed:
                 logger.debug("next_batch() got EndPartition")
                 if not self.train_mode and count > 0:
                     break
+                continue
+            if isinstance(chunk, marker.ColumnChunk):
+                self._colblock = (chunk, 0)
                 continue
             # chunk is a list of records (the batched redesign); tolerate a
             # stray single record for compatibility with hand-fed queues.

@@ -91,6 +91,13 @@ def start(authkey, queues, mode="local"):
     host = "localhost" if mode == "local" else ""
     _mgr = TFManager(address=(host, 0), authkey=authkey)
     _mgr.start()
+    # record the server child so engine teardown can kill a survivor if
+    # this executor dies un-gracefully (utils.track_child_pid contract)
+    proc = getattr(_mgr, "_process", None)
+    if proc is not None and proc.pid:
+        from tensorflowonspark_tpu.utils import track_child_pid
+
+        track_child_pid(proc.pid)
     for name in queues:  # pre-warm so queues exist before any consumer
         _mgr.get_queue(name)
     _mgr.set("state", "running")

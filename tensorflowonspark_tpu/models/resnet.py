@@ -111,14 +111,42 @@ def init(key, depth=50, num_classes=1000, width=64, small_inputs=False,
     return params, state
 
 
+def _stem_space_to_depth(w7, x):
+    """The 7x7/s2 stem as a 4x4/s1 conv over 2x2 space-to-depth input.
+
+    MXU-tiling fix for the ImageNet stem: a 3-input-channel conv wastes
+    most of a (128-lane) MXU pass.  Grouping 2x2 pixels into channels
+    (H,W,3 -> H/2,W/2,12) and folding the kernel accordingly computes the
+    EXACT same outputs (the 7x7 kernel zero-pads to 8x8 = 4 taps of
+    stride-2 phase pairs) with a 192-deep contraction instead of 147 on a
+    much squarer operand — the standard MLPerf-ResNet space-to-depth
+    transform, applied in-model so checkpoints keep the 7x7 layout.
+    """
+    b, h, w, c = x.shape
+    xs = x.reshape(b, h // 2, 2, w // 2, 2, c)
+    xs = xs.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * c)
+    # kernel: (7,7,C,O) -> zero row/col after -> (4,2,4,2,C,O) ->
+    # (p,q,u,v,C,O) -> (4,4,4C,O); channel order (u,v,c) matches xs
+    k = jnp.pad(w7, ((0, 1), (0, 1), (0, 0), (0, 0)))
+    k = k.reshape(4, 2, 4, 2, c, -1).transpose(0, 2, 1, 3, 4, 5)
+    k = k.reshape(4, 4, 4 * c, -1).astype(x.dtype)
+    # SAME geometry of the original: out 112 = in 112 with pad (1, 2)
+    return jax.lax.conv_general_dilated(
+        xs, k, window_strides=(1, 1), padding=((1, 2), (1, 2)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
 def apply(params, state, images, depth=50, train=True, small_inputs=False,
-          compute_dtype=jnp.bfloat16):
+          compute_dtype=jnp.bfloat16, stem_s2d=True):
     """images [N,H,W,3] → logits [N,num_classes]; returns (logits, new_state)."""
     kind, counts = _PLANS[depth]
     x = images.astype(compute_dtype)
     new_state = {}
     if small_inputs:
         x = L.conv(params["stem"], x)
+    elif stem_s2d and x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0:
+        x = _stem_space_to_depth(params["stem"]["w"], x)
     else:
         x = L.conv(params["stem"], x, stride=2)
     x, new_state["bn_stem"] = L.batchnorm(params["bn_stem"], state["bn_stem"], x, train)
@@ -139,17 +167,18 @@ def apply(params, state, images, depth=50, train=True, small_inputs=False,
 
 
 def make_train_step(optimizer, depth=50, small_inputs=False,
-                    compute_dtype=jnp.bfloat16, remat=False):
+                    compute_dtype=jnp.bfloat16, remat=False, stem_s2d=True):
     """(params, state, opt_state, images, labels) →
     (params, state, opt_state, loss, acc); jittable, SPMD-ready."""
 
     fwd = apply
     if remat:
-        fwd = jax.checkpoint(apply, static_argnums=(3, 4, 5, 6))
+        fwd = jax.checkpoint(apply, static_argnums=(3, 4, 5, 6, 7))
 
     def loss_fn(params, state, images, labels):
         logits, new_state = fwd(
-            params, state, images, depth, True, small_inputs, compute_dtype
+            params, state, images, depth, True, small_inputs, compute_dtype,
+            stem_s2d
         )
         return L.softmax_cross_entropy(logits, labels), (logits, new_state)
 

@@ -37,6 +37,8 @@ from tensorflowonspark_tpu import marker, rendezvous, tpu_info
 from tensorflowonspark_tpu.utils import (
     get_ip_address,
     read_executor_id,
+    reap_child,
+    track_child_pid,
     write_executor_id,
 )
 
@@ -213,9 +215,10 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
             executor_id = item
         assert executor_id is not None, "empty node partition"
 
-        # (1) claim TPU chips before any jax/XLA initialization
-        if num_chips > 0:
-            tpu_info.set_visible_chips(num_chips, _same_host_index(executor_id))
+        # (1) claim TPU chips before any jax/XLA initialization —
+        # scheduler (Spark-3 resources API) first, host scan second
+        # (decision table: tpu_info.claim_chips, ref TFSparkNode.py:170-229)
+        tpu_info.claim_chips(num_chips, _same_host_index(executor_id))
 
         # (2) role from template
         job_name, task_index = _job_for_executor(
@@ -350,6 +353,12 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
             p = fork.Process(target=wrapper_fn_background, args=(tf_args, ctx))
             p.daemon = job_name in ("ps", "evaluator")
             p.start()
+            # Reapability contract: the shutdown closure (manager KV) and the
+            # engine's teardown (pid file) must both be able to find this
+            # child — a crashed run must never leave an orphaned trainer
+            # wedging interpreter exit on the resource-tracker pipe.
+            mgr.set("bg_pid", p.pid)
+            track_child_pid(p.pid)
             if job_name in ("ps", "evaluator"):
                 _control_wait_loop(mgr, job_name)
         else:
@@ -429,6 +438,43 @@ def _await_consumption(mgr, waiter, feed_timeout, poll=1.0):
             raise TimeoutError("timed out waiting for consumption of partition")
 
 
+def _make_chunk_encoder():
+    """Per-partition chunk encoder: all-numeric row chunks go columnar
+    (marker.ColumnChunk via marshal.rows_to_columns — ~10x cheaper to
+    serialize, ~2x smaller on the wire than pickled row lists); chunks
+    with string/object/ragged columns stay as plain row lists."""
+    if os.environ.get("TFOS_COLUMNAR_FEED", "1") == "0":
+        return lambda chunk: chunk
+    from tensorflowonspark_tpu.recordio import marshal
+
+    state = {"spec": None, "off": False}
+
+    def encode(chunk):
+        if state["off"]:
+            return chunk
+        try:
+            if state["spec"] is None:
+                row = chunk[0]
+                if not isinstance(row, (tuple, list)):
+                    raise TypeError("non-tuple row")
+                spec = marshal.infer_spec(row)
+                if any(c == "O" for c, _ in spec):
+                    raise TypeError("object column")
+                state["spec"] = spec
+            return marker.ColumnChunk(
+                state["spec"],
+                marshal.rows_to_columns(chunk, state["spec"]),
+            )
+        except Exception as e:  # noqa: BLE001 - heterogeneous data: row path
+            state["off"] = True
+            logger.info(
+                "feed: row-chunk path (columnar not applicable: %s)", e
+            )
+            return chunk
+
+    return encode
+
+
 def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
     """Feeder closure: push partition records as chunks over the shm ring
     (fast path) or the manager queue (parity: TFSparkNode.train :448-515)."""
@@ -443,12 +489,14 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
             return
         ring = _open_feed_ring(mgr, qname)
         queue = None if ring is not None else mgr.get_queue(qname)
+        encode = _make_chunk_encoder()
 
         def put(chunk):
             """False once the consumer requested termination mid-feed: a
             put blocked on a full ring re-checks state each second, so a
             feeder never deadlocks against a consumer that stopped
             draining."""
+            chunk = encode(chunk)
             if ring is not None:
                 while True:
                     try:
@@ -519,8 +567,11 @@ def inference(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
         mgr = _get_manager(cluster_info, get_ip_address(), read_executor_id())
         ring = _open_feed_ring(mgr, qname)
         queue = None if ring is not None else mgr.get_queue(qname)
+        encode = _make_chunk_encoder()
 
         def put(item):
+            if isinstance(item, list):
+                item = encode(item)
             if ring is not None:
                 ring.put(item)
             else:
@@ -605,10 +656,29 @@ def shutdown(cluster_info, queues, cluster_id, grace_secs=0):
         # PEEK the error queue — get and put back — so an engine/Spark task
         # retry still observes the failure (TFSparkNode.py:624-630).
         equeue = mgr.get_queue("error")
+        err = None
         if not equeue.empty():
-            e_str = equeue.get()
-            equeue.put(e_str)
-            raise RuntimeError(f"exception in worker:\n{e_str}")
+            err = equeue.get()
+            equeue.put(err)
+        # Reap the background trainer: it received end-of-feed above and
+        # must exit on its own; a worker still alive past the bound is
+        # stuck (e.g. crashed feed left it blocked on the ring) and gets
+        # killed so no orphan survives the cluster.  A healthy trainer
+        # gets a generous post-feed window (final checkpoint/export can
+        # be slow — TFOS_REAP_TIMEOUT to widen further), and SIGTERM
+        # precedes SIGKILL; an already-errored worker is reaped fast.
+        bg_pid = mgr.get("bg_pid")
+        if bg_pid:
+            budget = (5.0 if err is not None else max(
+                grace_secs, float(os.environ.get("TFOS_REAP_TIMEOUT", "60"))
+            ))
+            exited = reap_child(int(str(bg_pid)), timeout=budget)
+            if not exited:
+                logger.warning("shutdown: background worker %s did not exit "
+                               "cleanly and was killed", bg_pid)
+            mgr.set("bg_pid", None)
+        if err is not None:
+            raise RuntimeError(f"exception in worker:\n{err}")
         mgr.set("state", "stopped")
 
     return _shutdown

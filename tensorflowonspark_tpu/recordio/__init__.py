@@ -17,26 +17,45 @@ from __future__ import annotations
 
 import ctypes
 
+from tensorflowonspark_tpu.recordio import fs as _fs
 from tensorflowonspark_tpu.recordio import native as _native
 from tensorflowonspark_tpu.recordio import pyimpl as _py
 
 
 class TFRecordWriter:
+    """Writes TFRecord framing to any filesystem.
+
+    Local paths go straight through the C library's buffered FILE* writer;
+    remote URLs (gs://, hdfs://, s3://, memory://) are framed in memory by
+    the C codec and flushed to the object store through fsspec on close
+    (objects on these stores are immutable — a single terminal PUT is the
+    native write pattern, not a defect of this path).
+    """
+
     def __init__(self, path):
         self._lib = _native.load()
-        if self._lib is not None:
-            self._h = self._lib.tfr_writer_open(str(path).encode())
-            if not self._h:
-                raise IOError(f"cannot open {path} for writing")
-            self._f = None
+        self._h = self._mh = self._f = None
+        self._remote_path = None
+        if _fs.is_local(path):
+            lp = _fs.local_path(path)
+            if self._lib is not None:
+                self._h = self._lib.tfr_writer_open(str(lp).encode())
+                if not self._h:
+                    raise IOError(f"cannot open {lp} for writing")
+            else:
+                self._f = open(lp, "wb")
+        elif self._lib is not None and getattr(self._lib, "_tfos_mem_api", False):
+            self._mh = self._lib.tfr_mem_writer_new()
+            self._remote_path = str(path)
         else:
-            self._h = None
-            self._f = open(path, "wb")
+            self._f = _fs.open_file(path, "wb")
 
     def write(self, data: bytes):
         if self._h is not None:
             if self._lib.tfr_writer_write(self._h, data, len(data)) != 0:
                 raise IOError("TFRecord write failed")
+        elif self._mh is not None:
+            self._lib.tfr_mem_writer_write(self._mh, data, len(data))
         else:
             _py.write_record(self._f, data)
 
@@ -44,6 +63,15 @@ class TFRecordWriter:
         if self._h is not None:
             self._lib.tfr_writer_close(self._h)
             self._h = None
+        elif self._mh is not None:
+            try:
+                n = ctypes.c_uint64()
+                p = self._lib.tfr_mem_writer_data(self._mh, ctypes.byref(n))
+                _fs.write_bytes(self._remote_path,
+                                ctypes.string_at(p, n.value) if n.value else b"")
+            finally:
+                self._lib.tfr_mem_writer_free(self._mh)
+                self._mh = None
         elif self._f is not None:
             self._f.close()
             self._f = None
@@ -56,15 +84,23 @@ class TFRecordWriter:
 
 
 class TFRecordReader:
-    """Iterates raw record bytes from one TFRecord file."""
+    """Iterates raw record bytes from one TFRecord file on any filesystem."""
 
     def __init__(self, path):
         self._path = path
         self._lib = _native.load()
 
     def __iter__(self):
+        if _fs.is_local(self._path):
+            yield from self._iter_local()
+        else:
+            yield from self._iter_remote()
+
+    def _iter_local(self):
         if self._lib is not None:
-            h = self._lib.tfr_reader_open(str(self._path).encode())
+            h = self._lib.tfr_reader_open(
+                str(_fs.local_path(self._path)).encode()
+            )
             if not h:
                 raise IOError(f"cannot open {self._path}")
             try:
@@ -79,8 +115,28 @@ class TFRecordReader:
             finally:
                 self._lib.tfr_reader_close(h)
         else:
-            with open(self._path, "rb") as f:
+            with open(_fs.local_path(self._path), "rb") as f:
                 yield from _py.read_records(f)
+
+    def _iter_remote(self):
+        data = _fs.read_bytes(self._path)
+        if self._lib is not None and getattr(self._lib, "_tfos_mem_api", False):
+            h = self._lib.tfr_mem_reader_new(data, len(data))
+            try:
+                buf = ctypes.POINTER(ctypes.c_uint8)()
+                while True:
+                    n = self._lib.tfr_mem_reader_next(h, ctypes.byref(buf))
+                    if n == -1:
+                        return
+                    if n < -1:
+                        raise IOError(f"corrupt TFRecord ({n}) in {self._path}")
+                    yield ctypes.string_at(buf, n) if n else b""
+            finally:
+                self._lib.tfr_mem_reader_free(h)
+        else:
+            import io
+
+            yield from _py.read_records(io.BytesIO(data))
 
 
 def encode_example(features: dict) -> bytes:

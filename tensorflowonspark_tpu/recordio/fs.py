@@ -1,0 +1,103 @@
+"""Filesystem routing for record IO: local fast path + fsspec for the rest.
+
+The reference reads and writes TFRecords on any Hadoop-compatible
+filesystem through the tensorflow-hadoop InputFormat/OutputFormat
+(reference dfutil.py:39-41,63-65, DFUtil.scala:37-40).  The TPU-native
+equivalent routes remote schemes (gs://, hdfs://, s3://, memory://, ...)
+through fsspec while plain local paths keep hitting the C library's
+fopen-based reader/writer directly.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_SCHEME_RE = re.compile(r"^([a-zA-Z][a-zA-Z0-9+.-]*)://")
+
+
+def scheme_of(path) -> str | None:
+    """URL scheme of a path, or None for plain local paths.
+
+    Windows drive letters don't appear here (TPU hosts are linux), so a
+    single-letter scheme is not special-cased.
+    """
+    m = _SCHEME_RE.match(str(path))
+    return m.group(1).lower() if m else None
+
+
+def is_local(path) -> bool:
+    s = scheme_of(path)
+    return s is None or s in ("file", "local")
+
+
+def local_path(path) -> str:
+    """Strip a file:// prefix down to an OS path (reference hdfs_path's
+    'file://' row, TFNode.py:40-49)."""
+    p = str(path)
+    s = scheme_of(p)
+    if s in ("file", "local"):
+        return p[len(s) + 3:] or "/"
+    return p
+
+
+def get_fs(path):
+    """(fsspec filesystem, path-within-fs) for any URL."""
+    import fsspec
+
+    return fsspec.core.url_to_fs(str(path))
+
+
+def open_file(path, mode="rb"):
+    """Open local paths with plain open(); remote through fsspec."""
+    if is_local(path):
+        return open(local_path(path), mode)
+    fs, p = get_fs(path)
+    return fs.open(p, mode)
+
+
+def read_bytes(path) -> bytes:
+    with open_file(path, "rb") as f:
+        return f.read()
+
+
+def write_bytes(path, data: bytes):
+    with open_file(path, "wb") as f:
+        f.write(data)
+
+
+def makedirs(path):
+    if is_local(path):
+        os.makedirs(local_path(path), exist_ok=True)
+    else:
+        fs, p = get_fs(path)
+        fs.makedirs(p, exist_ok=True)
+
+
+def isdir(path) -> bool:
+    if is_local(path):
+        return os.path.isdir(local_path(path))
+    fs, p = get_fs(path)
+    return fs.isdir(p)
+
+
+def exists(path) -> bool:
+    if is_local(path):
+        return os.path.exists(local_path(path))
+    fs, p = get_fs(path)
+    return fs.exists(p)
+
+
+def listdir(path):
+    """Names (not full paths) of a directory's entries."""
+    if is_local(path):
+        return os.listdir(local_path(path))
+    fs, p = get_fs(path)
+    return [name.rstrip("/").rsplit("/", 1)[-1] for name in fs.ls(p, detail=False)]
+
+
+def join(path, *parts) -> str:
+    """Join that preserves the URL scheme (os.path.join would not)."""
+    base = str(path).rstrip("/")
+    tail = "/".join(str(p).strip("/") for p in parts)
+    return f"{base}/{tail}" if tail else base

@@ -54,6 +54,22 @@ def native_available():
     return _load_ext() is not None
 
 
+def _ndarray_code(dtype):
+    """Spec code for a numpy dtype (lossless widening only: int8 'b' must
+    NOT collide with bool '?', uint64 does not fit int64)."""
+    if dtype.kind == "b":
+        return "?"
+    if dtype.kind == "i":
+        return "i" if dtype.itemsize <= 4 else "l"
+    if dtype.kind == "u":
+        if dtype.itemsize >= 8:
+            raise ValueError("uint64 columns do not fit the int64 spec")
+        return "i" if dtype.itemsize <= 2 else "l"
+    if dtype.kind == "f":
+        return "f" if dtype.itemsize <= 4 else "d"
+    raise ValueError(f"unsupported ndarray dtype {dtype}")
+
+
 def infer_spec(row):
     """Column spec from one example row (the schema-less path; the CLI's
     schema_hint translates to an explicit spec via schema_to_spec)."""
@@ -68,7 +84,11 @@ def infer_spec(row):
         elif isinstance(v, (bytes, str)):
             spec.append(("O", 0))
         elif isinstance(v, np.ndarray):
-            spec.append((np.asarray(v).dtype.char.replace("b", "?"), len(v)))
+            if v.ndim != 1:
+                raise ValueError(
+                    f"spec supports 1-D array columns, got shape {v.shape}"
+                )
+            spec.append((_ndarray_code(v.dtype), len(v)))
         elif isinstance(v, (list, tuple)):
             if not v:
                 raise ValueError("cannot infer dtype of empty sequence column")
@@ -134,7 +154,27 @@ def rows_to_columns(rows, spec=None):
             arr = np.empty(len(vals), dtype=object)
             arr[:] = vals
         else:
-            arr = np.asarray(vals, dtype=_CODE_TO_DTYPE[code])
+            if code in "?il":
+                # a spec inferred from an int first row must not silently
+                # truncate floats that appear in later rows — reject the
+                # lossy cast so callers fall back to the exact row path
+                natural = np.asarray(vals)
+                if natural.dtype.kind == "f" or (
+                    code == "?" and natural.dtype.kind != "b"
+                ):
+                    raise ValueError(
+                        f"column {c}: {natural.dtype} values under spec "
+                        f"{code!r} (lossy cast refused)"
+                    )
+                if code == "i" and natural.dtype.itemsize > 4:
+                    info = np.iinfo(np.int32)
+                    if (natural > info.max).any() or (natural < info.min).any():
+                        raise ValueError(
+                            f"column {c}: values overflow the int32 spec"
+                        )
+                arr = natural.astype(_CODE_TO_DTYPE[code], copy=False)
+            else:
+                arr = np.asarray(vals, dtype=_CODE_TO_DTYPE[code])
             if width and arr.shape[1:] != (width,):
                 raise ValueError(
                     f"column {c}: shape {arr.shape[1:]} != width {width}"

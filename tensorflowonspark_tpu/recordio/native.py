@@ -134,4 +134,26 @@ def _bind(lib):
 
     lib.tfr_crc32c.restype = c.c_uint32
     lib.tfr_crc32c.argtypes = [c.c_char_p, c.c_uint64]
+
+    # memory-buffer framing (remote-FS path: fsspec moves the bytes,
+    # the C library still does framing + crc); absent in pre-round-3 .so
+    # builds — callers check lib._tfos_mem_api and fall back to pyimpl
+    try:
+        lib.tfr_mem_writer_new.restype = c.c_void_p
+        lib.tfr_mem_writer_write.restype = c.c_int
+        lib.tfr_mem_writer_write.argtypes = [c.c_void_p, c.c_char_p, c.c_uint64]
+        lib.tfr_mem_writer_data.restype = u8p
+        lib.tfr_mem_writer_data.argtypes = [c.c_void_p, c.POINTER(c.c_uint64)]
+        lib.tfr_mem_writer_clear.argtypes = [c.c_void_p]
+        lib.tfr_mem_writer_free.argtypes = [c.c_void_p]
+        lib.tfr_mem_reader_new.restype = c.c_void_p
+        lib.tfr_mem_reader_new.argtypes = [c.c_char_p, c.c_uint64]
+        lib.tfr_mem_reader_next.restype = c.c_int64
+        lib.tfr_mem_reader_next.argtypes = [c.c_void_p, c.POINTER(u8p)]
+        lib.tfr_mem_reader_free.argtypes = [c.c_void_p]
+        lib._tfos_mem_api = True
+    except AttributeError:
+        logger.warning("native lib lacks the mem-buffer API (stale build); "
+                       "remote-FS record IO will use the python codec")
+        lib._tfos_mem_api = False
     return lib

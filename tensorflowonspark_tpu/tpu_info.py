@@ -92,9 +92,99 @@ def set_visible_chips(num_chips, worker_index=-1):
     (gpu_info.py format='CUDA' path).  Must run before jax initializes.
     """
     chips = get_chips(num_chips, worker_index)
+    _export_visible(chips)
+    return chips
+
+
+def _export_visible(chips):
     os.environ["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in chips)
     os.environ["TPU_CHIPS_PER_PROCESS_BOUNDS"] = f"1,{len(chips)},1"
     os.environ["TPU_PROCESS_BOUNDS"] = "1,1,1"
+
+
+# -- scheduler-integrated discovery (parity: TFSparkNode.py:170-229) ---------
+
+# Spark resource names that may carry accelerator addresses for this node.
+RESOURCE_NAMES = ("tpu", "gpu", "accelerator")
+
+
+def _has_spark_resource_api():
+    """True when a pyspark >= 3 TaskContext with resources() is importable
+    (parity: reference TFSparkNode._has_spark_resource_api)."""
+    try:
+        from pyspark import TaskContext  # noqa: F401
+
+        return hasattr(TaskContext, "resources")
+    except ImportError:
+        return False
+
+
+def _task_resources():
+    """{resource_name: [addresses]} from the scheduler's task context, or
+    None outside a Spark-3 task (patched by tests exactly like the
+    reference patches TaskContext.resources, test_TFSparkNode.py:49-187)."""
+    if not _has_spark_resource_api():
+        return None
+    from pyspark import TaskContext
+
+    context = TaskContext.get()
+    if context is None:
+        return None
+    resources = context.resources()
+    return {
+        name: list(info.addresses) for name, info in (resources or {}).items()
+    }
+
+
+def is_k8s():
+    """True inside a Spark-on-K8s executor pod (reference TFSparkNode.py:172
+    checks SPARK_EXECUTOR_POD_IP to work around device-plugin over-report)."""
+    return "SPARK_EXECUTOR_POD_IP" in os.environ
+
+
+def claim_chips(num_chips=0, worker_index=-1):
+    """Claim TPU chips for this process — the reference's _get_gpus decision
+    table (TFSparkNode.py:170-229) with chips instead of CUDA devices:
+
+    1. scheduler first: Spark-3 ``TaskContext.resources()`` addresses win
+       when present (truncated to ``num_chips`` when the user explicitly
+       asked for fewer);
+    2. otherwise, host scan — but NOT inside a K8s pod (the reference
+       skips the probe there: device plugins can advertise accelerators
+       to non-accelerator pods on shared nodes);
+    3. an explicit request that cannot be satisfied raises.
+
+    Exports the visible-chip env and returns the chip list (possibly []).
+    """
+    user_requested = num_chips > 0
+    resources = _task_resources()
+    chips = []
+    if resources:
+        for name in RESOURCE_NAMES:
+            if resources.get(name):
+                chips = [str(a) for a in resources[name]]
+                logger.info("scheduler %s resources: %s", name, chips)
+                break
+        if chips and user_requested and num_chips < len(chips):
+            logger.warning(
+                "requested %d chip(s), scheduler assigned %d; truncating",
+                num_chips, len(chips),
+            )
+            chips = chips[:num_chips]
+
+    # host scan only for an explicit request: unlike the reference's
+    # "default to 1 GPU", an unconstrained TPU process should keep the
+    # runtime's natural visibility of every host chip (SPMD-first).
+    if not chips and user_requested and not is_k8s() and is_tpu_available():
+        chips = [str(c) for c in get_chips(num_chips, worker_index)]
+
+    if user_requested and len(chips) < num_chips:
+        raise RuntimeError(
+            f"unable to allocate {num_chips} TPU chip(s); "
+            f"scheduler/host offered {chips}"
+        )
+    if chips:
+        _export_visible(chips)
     return chips
 
 

@@ -3,7 +3,11 @@
 from tensorflowonspark_tpu.utils.hostinfo import (  # noqa: F401
     find_in_path,
     get_ip_address,
+    kill_pid,
+    read_child_pids,
     read_executor_id,
+    reap_child,
     single_node_env,
+    track_child_pid,
     write_executor_id,
 )

@@ -54,6 +54,91 @@ def read_executor_id(cwd=None):
         return int(f.read())
 
 
+_CHILD_PIDS_FILE = "tfos_child_pids"
+
+
+def track_child_pid(pid, cwd=None):
+    """Record a forked/spawned long-lived child of this executor process.
+
+    The node task forks the background trainer and the IPC-manager server
+    inside the executor; if the executor is later killed un-gracefully
+    (engine teardown after a crashed run), those children re-parent to
+    init and outlive the job.  The pid file lets the engine's ``stop()``
+    kill survivors it can no longer reach through a manager.
+    """
+    path = os.path.join(cwd or os.getcwd(), _CHILD_PIDS_FILE)
+    try:
+        with open(path, "a") as f:
+            f.write(f"{pid}\n")
+    except OSError as e:  # best-effort bookkeeping only
+        logger.warning("could not record child pid %s: %s", pid, e)
+    return path
+
+
+def read_child_pids(cwd=None):
+    """Pids recorded by track_child_pid in the given working dir."""
+    path = os.path.join(cwd or os.getcwd(), _CHILD_PIDS_FILE)
+    if not os.path.exists(path):
+        return []
+    try:
+        with open(path) as f:
+            return [int(line) for line in f.read().split()]
+    except (OSError, ValueError):
+        return []
+
+
+def kill_pid(pid, sig=None):
+    """Send ``sig`` (default SIGKILL) to pid; True if the signal was sent."""
+    import signal as _signal
+
+    try:
+        os.kill(pid, _signal.SIGKILL if sig is None else sig)
+        return True
+    except (OSError, ProcessLookupError):
+        return False
+
+
+def reap_child(pid, timeout=5.0, term_first=True):
+    """Make a direct child exit and collect it: wait, then SIGTERM, then
+    SIGKILL; swallows 'not my child' so callers can use it opportunistically
+    from whichever process the shutdown closure happens to land in."""
+    import signal as _signal
+    import time as _time
+
+    deadline = _time.time() + timeout
+
+    def _gone():
+        try:
+            done, _ = os.waitpid(pid, os.WNOHANG)
+            if done == pid:
+                return True
+        except ChildProcessError:
+            # not our child (or already reaped): alive-check via signal 0
+            return not kill_pid(pid, 0)
+        except OSError:
+            return True
+        return False
+
+    while _time.time() < deadline:
+        if _gone():
+            return True
+        _time.sleep(0.1)
+    if term_first:
+        kill_pid(pid, _signal.SIGTERM)
+        grace = _time.time() + 2.0
+        while _time.time() < grace:
+            if _gone():
+                return True
+            _time.sleep(0.1)
+    kill_pid(pid)
+    final = _time.time() + 2.0
+    while _time.time() < final:
+        if _gone():
+            return True
+        _time.sleep(0.1)
+    return False
+
+
 def single_node_env(num_chips=0, worker_index=-1):
     """Set up a single-node environment (util.py:21-49 equivalent).
 

@@ -15,6 +15,19 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Strip any TPU-tunnel site hook (e.g. an axon sitecustomize on
+# PYTHONPATH) from the env that child processes inherit: the hook dials
+# the accelerator pool at *interpreter startup*, so LocalEngine executor
+# children would block whenever another process holds the tunnel — tests
+# must be runnable while a bench/profile owns the TPU.  Module imports in
+# spawn children are unaffected (sys.path travels via multiprocessing's
+# preparation data, not PYTHONPATH).
+_pp = os.environ.get("PYTHONPATH", "")
+if _pp:
+    os.environ["PYTHONPATH"] = os.pathsep.join(
+        p for p in _pp.split(os.pathsep) if "axon" not in p.lower()
+    )
+
 # A TPU-tunnel site hook may have forced jax_platforms at interpreter
 # start; pin the test session back to the virtual CPU platform before any
 # backend initializes.

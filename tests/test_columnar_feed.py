@@ -1,0 +1,111 @@
+"""Columnar feed path: feeder-side encoding (node._make_chunk_encoder) and
+DataFeed's ColumnChunk consumption must be byte-equivalent to the row path
+(the marshalling redesign of the reference's per-record pickle hop,
+TFSparkNode.py:480-482)."""
+
+import secrets
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import manager as tfmanager
+from tensorflowonspark_tpu import marker, node
+from tensorflowonspark_tpu.feed import DataFeed
+
+ROWS = [([float(i), float(2 * i)], i % 7) for i in range(100)]
+
+
+def test_encoder_numeric_rows_go_columnar():
+    enc = node._make_chunk_encoder()
+    chunk = enc(list(ROWS))
+    assert isinstance(chunk, marker.ColumnChunk)
+    assert len(chunk) == len(ROWS)
+    assert chunk.spec == [("d", 2), ("l", 0)]
+    np.testing.assert_allclose(chunk.columns[0][3], [3.0, 6.0])
+    assert chunk.columns[1][3] == 3
+
+
+def test_encoder_string_rows_stay_rows():
+    enc = node._make_chunk_encoder()
+    rows = [("hello", 1), ("world", 2)]
+    assert enc(rows) is rows
+    # and the encoder stays off for later chunks
+    assert enc(list(ROWS)) is not None
+    assert not isinstance(enc(list(ROWS)), marker.ColumnChunk)
+
+
+def test_encoder_ragged_rows_fall_back():
+    enc = node._make_chunk_encoder()
+    rows = [([1.0], 1), ([1.0, 2.0], 2)]
+    out = enc(rows)
+    assert out is rows
+
+
+def test_encoder_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("TFOS_COLUMNAR_FEED", "0")
+    enc = node._make_chunk_encoder()
+    assert enc(list(ROWS)) is not None
+    assert not isinstance(enc(list(ROWS)), marker.ColumnChunk)
+
+
+@pytest.fixture
+def mgr():
+    m = tfmanager.start(secrets.token_bytes(8), ["input", "output", "error"])
+    yield m
+    m.shutdown()
+
+
+def _feed_chunks(mgr, chunks):
+    q = mgr.get_queue("input")
+    for c in chunks:
+        q.put(c)
+    q.put(None)
+
+
+def _drain_batches(feed, batch_size):
+    out = []
+    while not feed.should_stop():
+        out.append(feed.next_batch(batch_size))
+    return out
+
+
+def test_datafeed_columnar_mapping_equals_row_path(mgr):
+    enc = node._make_chunk_encoder()
+    # batch size 16 deliberately misaligned with chunk size 24
+    _feed_chunks(mgr, [enc(ROWS[i:i + 24]) for i in range(0, 100, 24)])
+    feed = DataFeed(mgr, train_mode=True,
+                    input_mapping={"x": "features", "y": "label"})
+    batches = _drain_batches(feed, 16)
+    xs, ys = [], []
+    for b in batches:
+        assert isinstance(b["features"], list)
+        xs.extend(np.asarray(v) for v in b["features"])
+        ys.extend(int(v) for v in b["label"])
+    np.testing.assert_allclose(np.stack(xs), [r[0] for r in ROWS])
+    assert ys == [r[1] for r in ROWS]
+
+
+def test_datafeed_columnar_no_mapping_roundtrip(mgr):
+    enc = node._make_chunk_encoder()
+    _feed_chunks(mgr, [enc(ROWS[:50]), enc(ROWS[50:])])
+    feed = DataFeed(mgr, train_mode=True)
+    records = []
+    while not feed.should_stop():
+        records.extend(feed.next_batch(13))
+    assert len(records) == len(ROWS)
+    for got, want in zip(records, ROWS):
+        np.testing.assert_allclose(got[0], want[0])
+        assert got[1] == want[1]
+
+
+def test_datafeed_mixed_row_and_columnar_chunks(mgr):
+    enc = node._make_chunk_encoder()
+    _feed_chunks(mgr, [ROWS[:30], enc(ROWS[30:60]), ROWS[60:]])
+    feed = DataFeed(mgr, train_mode=True,
+                    input_mapping={"x": "features", "y": "label"})
+    total = 0
+    for b in _drain_batches(feed, 10):
+        n = len(b["label"])
+        assert len(b["features"]) == n
+        total += n
+    assert total == len(ROWS)

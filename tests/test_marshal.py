@@ -89,6 +89,50 @@ def test_row_arity_mismatch_rejected(impl):
         marshal.rows_to_columns([(1.0, 2.0), (3.0,)], [("d", 0), ("d", 0)])
 
 
+def test_lossy_casts_refused(impl):
+    """A spec inferred from an int/bool first row must not silently
+    truncate floats (2.9 -> 2) or coerce ints (2 -> True) that appear in
+    later rows — both paths must raise so the feed encoder falls back to
+    the exact row representation."""
+    with pytest.raises((TypeError, ValueError)):
+        marshal.rows_to_columns([(1,), (2.9,)], [("l", 0)])
+    with pytest.raises((TypeError, ValueError)):
+        marshal.rows_to_columns([(True,), (2,)], [("?", 0)])
+
+
+def test_numpy_bool_scalars_accepted(impl):
+    """np.bool_ fields (numpy/pandas-sourced rows) must marshal like
+    python bools on both paths."""
+    cols = marshal.rows_to_columns(
+        [(np.bool_(True),), (np.bool_(False),)], [("?", 0)]
+    )
+    assert cols[0].dtype == np.bool_
+    assert cols[0].tolist() == [True, False]
+
+
+def test_int32_spec_overflow_refused(impl):
+    with pytest.raises((OverflowError, ValueError)):
+        marshal.rows_to_columns([(1,), (2 ** 35,)], [("i", 0)])
+
+
+def test_infer_spec_int8_is_not_bool():
+    """numpy's int8 char 'b' must not collide with the bool code '?'
+    ([5,0,2] silently became [True,False,True] before round 3)."""
+    spec = marshal.infer_spec((np.array([5, 0, 2], np.int8),))
+    assert spec == [("i", 3)]
+    cols = marshal.rows_to_columns(
+        [(np.array([5, 0, 2], np.int8),)], spec
+    )
+    assert cols[0].tolist() == [[5, 0, 2]]
+
+
+def test_infer_spec_rejects_uint64_and_multidim():
+    with pytest.raises(ValueError):
+        marshal.infer_spec((np.array([1], np.uint64),))
+    with pytest.raises(ValueError):
+        marshal.infer_spec((np.zeros((2, 2), np.float32),))
+
+
 def test_schema_to_spec():
     fields = [("flag", "boolean"), ("n", "bigint"), ("x", "float"),
               ("emb", "array<double>"), ("name", "string")]

@@ -58,6 +58,33 @@ def test_resnet_shapes(depth, small, size, classes):
     assert not np.allclose(np.asarray(stem), 0.0)
 
 
+def test_resnet_stem_s2d_exact():
+    """The space-to-depth stem must compute exactly the 7x7/s2 conv
+    (MXU-tiling transform, resnet._stem_space_to_depth)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import layers as L
+    from tensorflowonspark_tpu.models import resnet
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, 32, 32, 3)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((7, 7, 3, 16)) * 0.1, jnp.float32)
+    ref = L.conv({"w": w}, x, stride=2)
+    s2d = resnet._stem_space_to_depth(w, x)
+    assert ref.shape == s2d.shape
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(s2d), atol=1e-4)
+    # end-to-end: apply() with and without the transform agree
+    params, state = resnet.init(jax.random.PRNGKey(0), depth=18,
+                                num_classes=10)
+    img = jnp.asarray(rng.standard_normal((2, 64, 64, 3)), jnp.float32)
+    a, _ = resnet.apply(params, state, img, depth=18, train=False,
+                        compute_dtype=jnp.float32, stem_s2d=False)
+    b, _ = resnet.apply(params, state, img, depth=18, train=False,
+                        compute_dtype=jnp.float32, stem_s2d=True)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
 def test_resnet56_cifar_train_step(cpu_devices):
     import jax
     import jax.numpy as jnp

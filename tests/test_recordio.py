@@ -101,6 +101,51 @@ def test_dfutil_save_load_local(tmp_path):
     assert not dfutil.is_loaded_df("/nonexistent")
 
 
+def test_tfrecord_remote_fs_roundtrip():
+    """Remote-FS path: TFRecord framing over an fsspec filesystem
+    (parity: reference record IO over any Hadoop FS, dfutil.py:39-81).
+    memory:// exercises the exact code path gs://, hdfs://, s3:// take."""
+    pytest.importorskip("fsspec")
+    path = "memory://tfos-test/data.tfrecord"
+    records = [b"first", b"", b"x" * 100_000]
+    with recordio.TFRecordWriter(path) as w:
+        for r in records:
+            w.write(r)
+    assert list(recordio.TFRecordReader(path)) == records
+    # bytes on the remote store are identical to the local framing
+    import io
+
+    from tensorflowonspark_tpu.recordio import fs as rfs
+
+    assert list(pyimpl.read_records(io.BytesIO(rfs.read_bytes(path)))) == records
+
+
+def test_dfutil_save_load_remote_fs():
+    pytest.importorskip("fsspec")
+    rows = [dict(ROW, an_int=i) for i in range(20)]
+    out = "memory://tfos-test/dfutil-tfr"
+    dfutil.save_as_tfrecords(rows, out)
+    loaded, schema = dfutil.load_tfrecords(None, out, BINARY_HINT)
+    assert sorted(r["an_int"] for r in loaded) == list(range(20))
+    assert schema["a_string"] == ("string", False)
+
+
+def test_gs_paths_route_remote():
+    """gs:// URLs must route to the fsspec/mem-codec path end-to-end, not
+    to fopen (round-2 finding: `gs://...` strings nothing could open)."""
+    from tensorflowonspark_tpu.recordio import fs as rfs
+
+    assert not rfs.is_local("gs://bucket/dir/part-r-00000")
+    assert rfs.scheme_of("hdfs://nn:8020/x") == "hdfs"
+    assert rfs.is_local("/plain/path") and rfs.is_local("file:///plain/path")
+    assert rfs.local_path("file:///plain/path") == "/plain/path"
+    assert rfs.join("gs://bucket/dir", "part-r-0") == "gs://bucket/dir/part-r-0"
+    pytest.importorskip("gcsfs")
+
+    fs, p = rfs.get_fs("gs://bucket/dir")  # resolves through gcsfs
+    assert type(fs).__module__.startswith("gcsfs")
+
+
 def test_dfutil_save_load_engine(tmp_path):
     from tensorflowonspark_tpu.engine import LocalEngine
 

@@ -45,3 +45,105 @@ def test_set_visible_chips_env():
         assert os.environ["TPU_VISIBLE_CHIPS"] == "4,5,6,7"
         for var in ("TPU_VISIBLE_CHIPS", "TPU_CHIPS_PER_PROCESS_BOUNDS", "TPU_PROCESS_BOUNDS"):
             os.environ.pop(var, None)
+
+
+# -- claim_chips decision table (parity: reference test_TFSparkNode.py:49-187
+#    GPU-allocation matrix over mocked TaskContext.resources / K8s env) ------
+
+def _clear_visible():
+    for var in ("TPU_VISIBLE_CHIPS", "TPU_CHIPS_PER_PROCESS_BOUNDS",
+                "TPU_PROCESS_BOUNDS"):
+        os.environ.pop(var, None)
+
+
+@pytest.fixture
+def clean_env():
+    _clear_visible()
+    yield
+    _clear_visible()
+
+
+def test_claim_scheduler_resources_win(clean_env):
+    """Spark-3 resources API addresses beat the host scan."""
+    with mock.patch.object(tpu_info, "_task_resources",
+                           return_value={"tpu": ["2", "3"]}):
+        with mock.patch.dict(os.environ, {"TFOS_TPU_CHIPS_PER_HOST": "8"}):
+            assert tpu_info.claim_chips(2, worker_index=0) == ["2", "3"]
+            assert os.environ["TPU_VISIBLE_CHIPS"] == "2,3"
+
+
+def test_claim_scheduler_truncates_to_request(clean_env):
+    """Explicit num_chips < scheduler assignment truncates (ref :193-197)."""
+    with mock.patch.object(tpu_info, "_task_resources",
+                           return_value={"tpu": ["0", "1", "2", "3"]}):
+        assert tpu_info.claim_chips(2) == ["0", "1"]
+    assert os.environ["TPU_VISIBLE_CHIPS"] == "0,1"
+
+
+def test_claim_scheduler_implicit_takes_all(clean_env):
+    """No explicit request: every scheduler-assigned address is claimed."""
+    with mock.patch.object(tpu_info, "_task_resources",
+                           return_value={"tpu": ["0", "1", "2", "3"]}):
+        assert tpu_info.claim_chips(0) == ["0", "1", "2", "3"]
+    assert os.environ["TPU_VISIBLE_CHIPS"] == "0,1,2,3"
+
+
+def test_claim_gpu_resource_name_accepted(clean_env):
+    """Clusters configured with the generic 'gpu' resource name still work."""
+    with mock.patch.object(tpu_info, "_task_resources",
+                           return_value={"gpu": ["5"]}):
+        assert tpu_info.claim_chips(1) == ["5"]
+
+
+def test_claim_host_scan_fallback(clean_env):
+    """No scheduler info: index-placed block from the host scan."""
+    with mock.patch.object(tpu_info, "_task_resources", return_value=None):
+        with mock.patch.dict(os.environ, {"TFOS_TPU_CHIPS_PER_HOST": "8"}):
+            assert tpu_info.claim_chips(2, worker_index=1) == ["2", "3"]
+            assert os.environ["TPU_VISIBLE_CHIPS"] == "2,3"
+
+
+def test_claim_k8s_pod_skips_host_scan(clean_env):
+    """Inside a K8s pod the host probe is skipped (device-plugin
+    over-report guard, ref TFSparkNode.py:201-203): explicit request fails
+    rather than claiming phantom chips."""
+    with mock.patch.object(tpu_info, "_task_resources", return_value=None):
+        with mock.patch.dict(os.environ, {
+            "TFOS_TPU_CHIPS_PER_HOST": "8",
+            "SPARK_EXECUTOR_POD_IP": "10.0.0.7",
+        }):
+            with pytest.raises(RuntimeError, match="unable to allocate"):
+                tpu_info.claim_chips(2)
+
+
+def test_claim_k8s_with_scheduler_resources(clean_env):
+    """K8s + resources API: the scheduler's explicit assignment is trusted."""
+    with mock.patch.object(tpu_info, "_task_resources",
+                           return_value={"tpu": ["0"]}):
+        with mock.patch.dict(os.environ, {"SPARK_EXECUTOR_POD_IP": "10.0.0.7"}):
+            assert tpu_info.claim_chips(1) == ["0"]
+
+
+def test_claim_unrequested_no_export(clean_env):
+    """No request + no scheduler info: natural full-host visibility —
+    nothing exported (TPU-first divergence from the reference's
+    default-to-1-GPU)."""
+    with mock.patch.object(tpu_info, "_task_resources", return_value=None):
+        with mock.patch.dict(os.environ, {"TFOS_TPU_CHIPS_PER_HOST": "8"}):
+            assert tpu_info.claim_chips(0) == []
+    assert "TPU_VISIBLE_CHIPS" not in os.environ
+
+
+def test_claim_unsatisfiable_request_raises(clean_env):
+    with mock.patch.object(tpu_info, "_task_resources", return_value=None):
+        with mock.patch.dict(os.environ, {"TFOS_TPU_CHIPS_PER_HOST": "0"}):
+            with pytest.raises(RuntimeError, match="unable to allocate"):
+                tpu_info.claim_chips(1)
+
+
+def test_no_pyspark_resource_api_probe():
+    """Outside any Spark task (no pyspark installed) discovery degrades
+    to None without raising."""
+    assert tpu_info._task_resources() is None or isinstance(
+        tpu_info._task_resources(), dict
+    )
