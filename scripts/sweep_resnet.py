@@ -1,0 +1,112 @@
+"""One-process ResNet-50 perf sweep: measures several configurations under
+a single TPU claim (the tunnel serializes claims, so N processes would pay
+N claim round-trips).
+
+Sweeps: stem (s2d vs 7x7), batch size, remat; prints one line per config
+and a final ranking.  Use TFOS_SWEEP=batch256,batch512,... to subset.
+
+Usage: python scripts/sweep_resnet.py [--steps 10]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def measure(step_fn, params, state, opt_state, images, labels, steps):
+    import jax
+    from jax import lax
+
+    @jax.jit
+    def run(params, state, opt_state, images, labels):
+        def body(carry, _):
+            p, s, o = carry
+            p, s, o, loss, _ = step_fn(p, s, o, images, labels)
+            return (p, s, o), loss
+        (_, _, _), losses = lax.scan(
+            body, (params, state, opt_state), None, length=steps)
+        return losses[-1]
+
+    t0 = time.perf_counter()
+    float(run(params, state, opt_state, images, labels))  # compile+warmup
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    float(run(params, state, opt_state, images, labels))
+    dt = time.perf_counter() - t0
+    return dt / steps, compile_s
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--image", type=int, default=224)
+    args = ap.parse_args()
+
+    import jax
+    import optax
+
+    from tensorflowonspark_tpu.models import resnet
+
+    dev = jax.devices()[0]
+    peak = 197e12  # v5e bf16
+    flops_img = 3.0 * resnet.flops_per_image(50, args.image)
+    print(f"device: {dev} ({getattr(dev, 'device_kind', '?')})", flush=True)
+
+    opt = optax.sgd(0.1, momentum=0.9)
+
+    @jax.jit
+    def init_all(key):
+        params, state = resnet.init(jax.random.PRNGKey(0), depth=50,
+                                    num_classes=1000)
+        return params, state, opt.init(params)
+
+    print("init...", flush=True)
+    params, state, opt_state = init_all(jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+    print("init done", flush=True)
+
+    configs = [
+        # (name, batch, stem_s2d, remat)
+        ("b256_s2d", 256, True, False),
+        ("b256_7x7", 256, False, False),
+        ("b512_s2d", 512, True, False),
+        ("b512_s2d_remat", 512, True, True),
+        ("b1024_s2d_remat", 1024, True, True),
+    ]
+    subset = os.environ.get("TFOS_SWEEP")
+    if subset:
+        want = set(subset.split(","))
+        configs = [c for c in configs if c[0] in want]
+
+    rng = np.random.default_rng(0)
+    results = []
+    for name, batch, s2d, remat in configs:
+        try:
+            import jax.numpy as jnp
+
+            images = jnp.asarray(
+                rng.random((batch, args.image, args.image, 3),
+                           dtype=np.float32), jnp.bfloat16)
+            labels = jnp.asarray(rng.integers(0, 1000, batch), jnp.int32)
+            step_fn = resnet.make_train_step(
+                opt, depth=50, stem_s2d=s2d, remat=remat)
+            sec, compile_s = measure(
+                step_fn, params, state, opt_state, images, labels, args.steps)
+            ips = batch / sec
+            mfu = ips * flops_img / peak
+            print(f"{name:18s} step={sec*1e3:7.1f}ms  img/s={ips:7.0f}  "
+                  f"mfu={mfu:.4f}  (compile {compile_s:.0f}s)", flush=True)
+            results.append((mfu, name))
+        except Exception as e:  # noqa: BLE001 - keep sweeping
+            print(f"{name:18s} FAILED: {str(e)[:160]}", flush=True)
+    for mfu, name in sorted(results, reverse=True):
+        print(f"  {mfu:.4f}  {name}")
+
+
+if __name__ == "__main__":
+    main()

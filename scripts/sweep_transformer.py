@@ -1,0 +1,106 @@
+"""One-process transformer perf sweep under a single TPU claim:
+batch size x flash block sizes at dim 1024 / 8 layers / seq 2048
+(the bench.py flagship config; bf16 logits freed ~2GB HBM, so batch 16
+should now fit).
+
+Usage: python scripts/sweep_transformer.py [--steps 8]
+"""
+
+import argparse
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=8)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax import lax
+
+    from tensorflowonspark_tpu import ops
+    from tensorflowonspark_tpu.models import transformer
+    from tensorflowonspark_tpu.utils import metrics as M
+
+    cfg = transformer.Config(
+        vocab_size=16384, dim=1024, n_layers=8, n_heads=8,
+        max_seq=2048, dtype="bfloat16", attn_impl="flash",
+    )
+    peak = 197e12
+    flops_tok = M.transformer_flops_per_token(cfg)
+    opt = optax.adam(1e-3)
+
+    @jax.jit
+    def init_all(key):
+        params = transformer.init(key, cfg)
+        return params, opt.init(params)
+
+    print("init...", flush=True)
+    params, opt_state = init_all(jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+    print("init done", flush=True)
+
+    configs = [
+        # (name, batch, block_q, block_kv)
+        ("b8_q512_kv512", 8, 512, 512),
+        ("b16_q512_kv512", 16, 512, 512),
+        ("b16_q1024_kv512", 16, 1024, 512),
+        ("b16_q512_kv1024", 16, 512, 1024),
+        ("b16_q1024_kv1024", 16, 1024, 1024),
+        ("b32_q512_kv512", 32, 512, 512),
+    ]
+    subset = os.environ.get("TFOS_SWEEP")
+    if subset:
+        want = set(subset.split(","))
+        configs = [c for c in configs if c[0] in want]
+
+    rng = np.random.default_rng(0)
+    results = []
+    for name, batch, bq, bkv in configs:
+        try:
+            tokens = jnp.asarray(
+                rng.integers(0, cfg.vocab_size, (batch, cfg.max_seq)),
+                jnp.int32)
+            attn = functools.partial(
+                ops.flash_attention, causal=True, block_q=bq, block_kv=bkv)
+
+            @jax.jit
+            def run(params, opt_state, tokens):
+                def body(carry, _):
+                    p, o = carry
+                    loss, grads = jax.value_and_grad(transformer.loss_fn)(
+                        p, tokens, cfg, attn_fn=attn)
+                    updates, o = opt.update(grads, o)
+                    return (optax.apply_updates(p, updates), o), loss
+                (_, _), losses = lax.scan(
+                    body, (params, opt_state), None, length=args.steps)
+                return losses[-1]
+
+            t0 = time.perf_counter()
+            float(run(params, opt_state, tokens))
+            compile_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            float(run(params, opt_state, tokens))
+            dt = time.perf_counter() - t0
+            tps = batch * cfg.max_seq * args.steps / dt
+            mfu = tps * flops_tok / peak
+            print(f"{name:18s} tok/s={tps:9.0f}  mfu={mfu:.4f}  "
+                  f"(compile {compile_s:.0f}s)", flush=True)
+            results.append((mfu, name))
+        except Exception as e:  # noqa: BLE001 - keep sweeping
+            print(f"{name:18s} FAILED: {str(e)[:160]}", flush=True)
+    for mfu, name in sorted(results, reverse=True):
+        print(f"  {mfu:.4f}  {name}")
+
+
+if __name__ == "__main__":
+    main()

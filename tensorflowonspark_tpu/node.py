@@ -663,14 +663,15 @@ def shutdown(cluster_info, queues, cluster_id, grace_secs=0):
         # Reap the background trainer: it received end-of-feed above and
         # must exit on its own; a worker still alive past the bound is
         # stuck (e.g. crashed feed left it blocked on the ring) and gets
-        # killed so no orphan survives the cluster.  A healthy trainer
-        # gets a generous post-feed window (final checkpoint/export can
-        # be slow — TFOS_REAP_TIMEOUT to widen further), and SIGTERM
-        # precedes SIGKILL; an already-errored worker is reaped fast.
+        # killed so no orphan survives the cluster.  The healthy-path
+        # budget is deliberately long (feed_timeout scale): the trainer
+        # may still be consuming queued batches, compiling, or writing a
+        # final checkpoint, and killing working user code loses data — an
+        # already-errored worker is reaped fast instead.
         bg_pid = mgr.get("bg_pid")
         if bg_pid:
             budget = (5.0 if err is not None else max(
-                grace_secs, float(os.environ.get("TFOS_REAP_TIMEOUT", "60"))
+                grace_secs, float(os.environ.get("TFOS_REAP_TIMEOUT", "600"))
             ))
             exited = reap_child(int(str(bg_pid)), timeout=budget)
             if not exited:
