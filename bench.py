@@ -236,7 +236,30 @@ def main():
 
     from tensorflowonspark_tpu.models import resnet
 
-    dev = jax.devices()[0]
+    # backend init retry: a TPU pool can answer UNAVAILABLE transiently
+    # (observed: tunnel claim errors that clear after minutes) — one
+    # retry cycle is cheap insurance for an unattended bench run
+    dev = None
+    for attempt in range(int(os.environ.get("TFOS_BENCH_INIT_RETRIES", "3"))):
+        try:
+            dev = jax.devices()[0]
+            break
+        except RuntimeError as e:
+            import sys
+
+            if "UNAVAILABLE" not in str(e):
+                raise  # permanent misconfiguration: fail fast
+            print(f"bench: backend init failed (try {attempt + 1}): "
+                  f"{str(e)[:120]}", file=sys.stderr, flush=True)
+            try:  # drop the cached failure so the next call re-dials
+                from jax._src import xla_bridge as _xb
+
+                _xb._clear_backends()
+            except Exception:  # noqa: BLE001 - internal API may move
+                pass
+            time.sleep(60 * (attempt + 1))
+    if dev is None:
+        dev = jax.devices()[0]  # final attempt; let the real error surface
     guessed_tpu = on_tpu
     on_tpu = dev.platform != "cpu"
     if on_tpu != guessed_tpu:

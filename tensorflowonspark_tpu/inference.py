@@ -92,7 +92,9 @@ def run(args, source=None):
             )
         preds = model.transform(ds)
 
-        os.makedirs(args.output, exist_ok=True)
+        from tensorflowonspark_tpu.recordio import fs as _fs
+
+        _fs.makedirs(args.output)
         shards = preds.map_partitions(_write_json(args.output)).collect()
         shards = [s for s in shards if s]
         logger.info("wrote %d shards under %s", len(shards), args.output)
@@ -114,15 +116,17 @@ def _write_json(output_dir):
         import os as _os
         import uuid as _uuid
 
+        from tensorflowonspark_tpu.recordio import fs as _ffs
+
         rows = list(it)
         if not rows:
             return []
         # unique per partition: pid alone repeats when one executor gets
         # several partitions, and id()-style keys can collide after reuse
-        path = _os.path.join(
+        path = _ffs.join(
             output_dir, f"part-{_os.getpid()}-{_uuid.uuid4().hex[:8]}.json"
         )
-        with open(path, "w") as f:
+        with _ffs.open_file(path, "w") as f:
             for row in rows:
                 f.write(_json.dumps(row) + "\n")
         return [path]

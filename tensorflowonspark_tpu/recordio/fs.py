@@ -88,6 +88,14 @@ def exists(path) -> bool:
     return fs.exists(p)
 
 
+def remove(path):
+    if is_local(path):
+        os.remove(local_path(path))
+    else:
+        fs, p = get_fs(path)
+        fs.rm(p)
+
+
 def listdir(path):
     """Names (not full paths) of a directory's entries."""
     if is_local(path):

@@ -14,11 +14,14 @@ feeding stops.  Here:
 
 from __future__ import annotations
 
+import io
 import json
 import logging
 import os
 
 import numpy as np
+
+from tensorflowonspark_tpu.recordio import fs as _fs
 
 logger = logging.getLogger(__name__)
 
@@ -45,32 +48,45 @@ def _unflatten(flat):
 
 
 def save_checkpoint(ckpt_dir, params, step, keep=3):
-    """Write step-stamped npz checkpoint; prune old ones."""
-    os.makedirs(ckpt_dir, exist_ok=True)
+    """Write step-stamped npz checkpoint to any filesystem (local,
+    gs://, hdfs://, ... via fsspec); prune old ones."""
+    _fs.makedirs(ckpt_dir)
     flat = _flatten(_to_host(params))
-    path = os.path.join(ckpt_dir, f"ckpt-{step:08d}.npz")
-    # pid-unique tmp: concurrent writers (several workers sharing one
-    # filesystem) must not clobber each other's in-flight file
-    tmp = f"{path}.{os.getpid()}.tmp"
-    with open(tmp, "wb") as f:
-        np.savez(f, **flat)
-    os.replace(tmp, path)  # atomic publish
+    path = _fs.join(ckpt_dir, f"ckpt-{step:08d}.npz")
+    if _fs.is_local(ckpt_dir):
+        lp = _fs.local_path(path)
+        # pid-unique tmp: concurrent writers (several workers sharing one
+        # filesystem) must not clobber each other's in-flight file
+        tmp = f"{lp}.{os.getpid()}.tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **flat)
+        os.replace(tmp, lp)  # atomic publish
+    else:
+        buf = io.BytesIO()  # object stores publish atomically on PUT
+        np.savez(buf, **flat)
+        _fs.write_bytes(path, buf.getvalue())
     logger.info("saved checkpoint %s", path)
-    ckpts = sorted(p for p in os.listdir(ckpt_dir) if p.startswith("ckpt-"))
+    ckpts = sorted(
+        p for p in _fs.listdir(ckpt_dir)
+        if p.startswith("ckpt-") and p.endswith(".npz")
+    )
     for old in ckpts[:-keep]:
-        os.remove(os.path.join(ckpt_dir, old))
+        _fs.remove(_fs.join(ckpt_dir, old))
     return path
 
 
 def latest_checkpoint(ckpt_dir):
-    if not os.path.isdir(ckpt_dir):
+    if not _fs.isdir(ckpt_dir):
         return None
-    ckpts = sorted(p for p in os.listdir(ckpt_dir) if p.startswith("ckpt-"))
-    return os.path.join(ckpt_dir, ckpts[-1]) if ckpts else None
+    ckpts = sorted(
+        p for p in _fs.listdir(ckpt_dir)
+        if p.startswith("ckpt-") and p.endswith(".npz")
+    )
+    return _fs.join(ckpt_dir, ckpts[-1]) if ckpts else None
 
 
 def load_checkpoint(path):
-    with np.load(path) as z:
+    with _fs.open_file(path, "rb") as f, np.load(f) as z:
         return _unflatten({k: z[k] for k in z.files})
 
 
@@ -81,23 +97,24 @@ def export_model(export_dir, params, ctx=None, metadata=None):
         logger.info("export_model: not chief (%s:%s), skipping",
                     ctx.job_name, ctx.task_index)
         return None
-    os.makedirs(export_dir, exist_ok=True)
+    _fs.makedirs(export_dir)
     flat = _flatten(_to_host(params))
-    with open(os.path.join(export_dir, "params.npz"), "wb") as f:
-        np.savez(f, **flat)
+    buf = io.BytesIO()
+    np.savez(buf, **flat)
+    _fs.write_bytes(_fs.join(export_dir, "params.npz"), buf.getvalue())
     meta = {"format": "tfos-tpu-export-v1"}
     meta.update(metadata or {})
-    with open(os.path.join(export_dir, "export.json"), "w") as f:
-        json.dump(meta, f)
+    _fs.write_bytes(_fs.join(export_dir, "export.json"),
+                    json.dumps(meta).encode())
     logger.info("exported model to %s", export_dir)
     return export_dir
 
 
 def load_exported(export_dir):
-    with np.load(os.path.join(export_dir, "params.npz")) as z:
+    with _fs.open_file(_fs.join(export_dir, "params.npz"), "rb") as f, \
+            np.load(f) as z:
         params = _unflatten({k: z[k] for k in z.files})
-    with open(os.path.join(export_dir, "export.json")) as f:
-        meta = json.load(f)
+    meta = json.loads(_fs.read_bytes(_fs.join(export_dir, "export.json")))
     return params, meta
 
 
@@ -178,9 +195,13 @@ class AsyncCheckpointer:
     def __init__(self, ckpt_dir, keep=3):
         import orbax.checkpoint as ocp
 
+        # URLs (gs://...) go to orbax/tensorstore verbatim; only plain
+        # local paths are absolutized (os.path.abspath would mangle a URL)
+        if _fs.is_local(ckpt_dir):
+            ckpt_dir = os.path.abspath(_fs.local_path(ckpt_dir))
         self._ocp = ocp
         self._mngr = ocp.CheckpointManager(
-            os.path.abspath(ckpt_dir),
+            ckpt_dir,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=keep, enable_async_checkpointing=True
             ),

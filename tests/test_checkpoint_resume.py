@@ -43,6 +43,34 @@ def test_pack_unpack_optax_state():
     assert ckpt._flatten({"opt": packed})
 
 
+def test_save_restore_remote_fs():
+    """Checkpoints and exports must work on fsspec URLs (model_dir on
+    gs://... is the north-star workflow; memory:// exercises the same
+    code path)."""
+    pytest.importorskip("fsspec")
+    d = "memory://tfos-ckpt-test/ckpt"
+    tree = {"w": np.arange(4, dtype=np.float32), "b": np.zeros(2)}
+    ckpt.save_checkpoint(d, tree, step=3)
+    ckpt.save_checkpoint(d, {"w": tree["w"] * 2, "b": tree["b"]}, step=9)
+    restored, step = ckpt.restore_latest(d)
+    assert step == 9
+    np.testing.assert_allclose(restored["w"], tree["w"] * 2)
+    # keep=3 pruning across saves on the remote store
+    for s in (11, 12, 13):
+        ckpt.save_checkpoint(d, tree, step=s, keep=2)
+    import fsspec
+
+    fs, p = fsspec.core.url_to_fs(d)
+    names = [n for n in fs.ls(p, detail=False) if "ckpt-" in n]
+    assert len(names) == 2
+
+    e = "memory://tfos-ckpt-test/export"
+    ckpt.export_model(e, tree, metadata={"predict": "m:f"})
+    params, meta = ckpt.load_exported(e)
+    np.testing.assert_allclose(params["w"], tree["w"])
+    assert meta["predict"] == "m:f"
+
+
 def test_async_checkpointer_orbax(tmp_path):
     """The orbax path must actually save and restore (round-1 finding:
     it was an untested 6-line wrapper)."""
