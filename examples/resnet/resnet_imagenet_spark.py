@@ -32,7 +32,7 @@ def main_fun(args, ctx):
     import jax
     import optax
 
-    from tensorflowonspark_tpu.infeed import device_feed
+    from tensorflowonspark_tpu.infeed import device_feed, synchronized
     from tensorflowonspark_tpu.models import resnet
     from tensorflowonspark_tpu.parallel import (
         batch_sharding, local_to_global, make_mesh, shard_train_state,
@@ -93,10 +93,13 @@ def main_fun(args, ctx):
         )
 
     loss = acc = 0.0
-    for imgs, labels in device_feed(
+    # synchronized(): all processes stop on the same step at end of
+    # feed even when ragged tails leave them different batch counts —
+    # no stranded all-reduce, no reference-style "90% of steps" trick
+    for imgs, labels in synchronized(device_feed(
         feed, per_proc, collate=collate, depth=2,
         placement=lambda b: local_to_global(mesh, b),
-    ):
+    ), feed=feed):
         params, state, opt_state, loss, acc = step_fn(
             params, state, opt_state, imgs, labels
         )

@@ -82,6 +82,8 @@ def main():
     if subset:
         want = set(subset.split(","))
         configs = [c for c in configs if c[0] in want]
+    if os.environ.get("TFOS_SWEEP_SMOKE") == "1":  # plumbing check (CPU)
+        configs = [(n, 4, s, r) for n, _, s, r in configs[:2]]
 
     rng = np.random.default_rng(0)
     results = []

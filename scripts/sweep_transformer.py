@@ -31,9 +31,15 @@ def main():
     from tensorflowonspark_tpu.models import transformer
     from tensorflowonspark_tpu.utils import metrics as M
 
+    smoke = os.environ.get("TFOS_SWEEP_SMOKE") == "1"
     cfg = transformer.Config(
-        vocab_size=16384, dim=1024, n_layers=8, n_heads=8,
-        max_seq=2048, dtype="bfloat16", attn_impl="flash",
+        vocab_size=512 if smoke else 16384,
+        dim=128 if smoke else 1024,
+        n_layers=2 if smoke else 8,
+        n_heads=4 if smoke else 8,
+        max_seq=256 if smoke else 2048,
+        dtype="float32" if smoke else "bfloat16",
+        attn_impl="flash",
     )
     peak = 197e12
     flops_tok = M.transformer_flops_per_token(cfg)
@@ -62,6 +68,9 @@ def main():
     if subset:
         want = set(subset.split(","))
         configs = [c for c in configs if c[0] in want]
+    if smoke:  # plumbing check (CPU): tiny batch, blocks fitting max_seq
+        configs = [(n, 1, min(bq, 128), min(bkv, 128))
+                   for n, _, bq, bkv in configs[:2]]
 
     rng = np.random.default_rng(0)
     results = []

@@ -82,6 +82,60 @@ def prefetch_to_device(it, depth=2, placement=None):
         yield item
 
 
+def synchronized(it, feed=None):
+    """Yield from ``it`` only while EVERY process still has a next item.
+
+    The principled global-stop for ragged end-of-feed tails under
+    synchronous collectives (SURVEY.md §7 hard parts): after end-of-feed,
+    workers are left with DIFFERENT numbers of residual full batches, and
+    a worker stepping one extra time would strand its peers' all-reduce —
+    the reference's workaround was "train only 90% of the steps"
+    (reference examples/mnist/keras/mnist_spark.py:58-66).  Here every
+    process all-gathers a has-data flag before stepping, so all processes
+    stop on exactly the same step.  The exchange is once per item,
+    unconditionally — amortizing it would reintroduce the hang it
+    prevents (a process that runs dry mid-window cannot participate in
+    peers' device collectives).
+
+    Pass ``feed`` (the DataFeed backing ``it``) so a process stopped
+    with local batches remaining drains them (``feed.terminate()``),
+    keeping the feeder-side consumption protocol intact.
+
+    Scope: this aligns the *end-of-feed* tail — the signal that a feed is
+    dry is its end-of-feed marker.  A worker starved MID-train (its
+    partitions exhausted while peers keep receiving data, beyond what the
+    prefetch/ring buffers absorb) blocks waiting for data before it can
+    reach the flag exchange; keep per-worker record counts roughly
+    balanced during feeding, as the engine's partitioning does (and as
+    the reference equally required).
+
+    Single-process: a plain passthrough with zero collectives.
+    """
+    import jax
+
+    if jax.process_count() <= 1:
+        yield from it
+        return
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    while True:
+        item = next(it, None)
+        mine = item is not None
+        flags = multihost_utils.process_allgather(np.asarray(mine))
+        if not bool(np.asarray(flags).all()):
+            if mine:
+                logger.info(
+                    "synchronized: a peer's feed ended; draining local "
+                    "remainder"
+                )
+                if feed is not None:
+                    feed.terminate()
+            return
+        yield item
+
+
 def device_feed(feed, batch_size, *, collate=None, depth=2, placement=None,
                 min_batch=None):
     """The composed fast path: DataFeed -> collate -> double-buffered

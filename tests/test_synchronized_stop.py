@@ -1,0 +1,81 @@
+"""Global-stop on uneven partitions (SURVEY.md §7 hard parts): two real
+jax.distributed processes with different amounts of local data must stop
+on the same step — no stranded collective, no hang — via
+infeed.synchronized."""
+
+import multiprocessing as mp
+import os
+import socket
+
+import pytest
+
+
+def _worker(rank, port, counts, q):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    os.environ.pop("PYTHONPATH", None)
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=len(counts),
+        process_id=rank,
+    )
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    from tensorflowonspark_tpu.infeed import synchronized
+
+    seen = list(synchronized(iter(range(counts[rank]))))
+
+    # alignment proof: a cross-process collective still completes after
+    # the uneven stop (this is exactly what hangs without the wrapper);
+    # it also asserts both ranks consumed the same number of items
+    all_counts = multihost_utils.process_allgather(np.asarray(len(seen)))
+    assert int(np.asarray(all_counts).min()) == int(
+        np.asarray(all_counts).max()
+    ), all_counts
+    q.put((rank, len(seen)))
+
+
+def _worker_main(rank, port, counts, q):
+    try:
+        _worker(rank, port, counts, q)
+    except Exception as e:  # noqa: BLE001 - surface in the parent
+        q.put((rank, f"ERROR: {e!r}"))
+
+
+@pytest.mark.slow
+def test_uneven_feeds_stop_together():
+    ctx = mp.get_context("spawn")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    counts = (5, 3)  # rank 0 has more data than rank 1
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=_worker_main, args=(r, port, counts, q))
+        for r in range(2)
+    ]
+    try:
+        for p in procs:
+            p.start()
+        results = {}
+        for _ in procs:
+            rank, n = q.get(timeout=120)
+            results[rank] = n
+        for p in procs:
+            p.join(timeout=30)
+            assert p.exitcode == 0, (p.exitcode, results)
+    finally:
+        # a deadlocked rank must not wedge pytest's exit (non-daemon
+        # children are joined by multiprocessing's atexit handler)
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=5)
+            if p.is_alive():
+                p.kill()
+                p.join(timeout=5)
+    # both stopped after the shorter feed's 3 items
+    assert results == {0: 3, 1: 3}, results
