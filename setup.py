@@ -16,6 +16,9 @@ setup(
     extras_require={
         "tpu": ["jax", "optax", "orbax-checkpoint"],
         "spark": ["pyspark>=3.0"],
+        # remote record IO / checkpoints on gs:// (other schemes: install
+        # the matching fsspec driver, e.g. s3fs, pyarrow for hdfs)
+        "fs": ["fsspec", "gcsfs"],
     },
     entry_points={
         "console_scripts": [

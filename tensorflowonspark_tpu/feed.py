@@ -14,6 +14,8 @@ chunk that overfills the requested batch carries into the next call.
 from __future__ import annotations
 
 import logging
+import queue as _queue
+import threading
 import time
 
 from tensorflowonspark_tpu import marker
@@ -99,19 +101,44 @@ class DataFeed:
         )
         self._buffer = []  # leftover records from a partially-consumed chunk
         self._colblock = None  # (ColumnChunk, offset): partially-consumed
+        # The ring is single-consumer: a prefetch thread (infeed.py) and a
+        # terminate() caller must never pop concurrently.  Gets poll under
+        # this lock in short slices and re-check the stop flag between
+        # slices, so terminate() from another thread can always interleave.
+        self._lock = threading.Lock()
+        self._stop_requested = False
+        self._queue = None  # cached manager queue proxy (compat path)
         # shm fast path; the handshake (open_feed_ring) is shared with the
         # producer closures so both sides always agree on the transport
         self._ring = open_feed_ring(mgr, qname_in, producer=False)
 
-    def _get_chunk(self, timeout_ms=-1):
-        """Next chunk from the fast or compat transport (blocking)."""
+    def _get_once(self, timeout_ms):
+        """One bounded pop attempt; raises TimeoutError when empty."""
+        with self._lock:
+            if self._ring is not None:
+                return self._ring.get(timeout_ms)
+            if self._queue is None:  # resolve the manager proxy once
+                self._queue = self.mgr.get_queue(self.qname_in)
+            try:
+                chunk = self._queue.get(block=True, timeout=timeout_ms / 1000.0)
+            except _queue.Empty:
+                raise TimeoutError("feed queue empty") from None
+            self._queue.task_done()
+            return chunk
+
+    def _get_chunk(self):
+        """Next chunk from the fast or compat transport: blocks until data
+        arrives or terminate() is requested (then reports end-of-feed)."""
         t0 = time.perf_counter() if self.metrics is not None else None
-        if self._ring is not None:
-            chunk = self._ring.get(timeout_ms)
-        else:
-            queue = self.mgr.get_queue(self.qname_in)
-            chunk = queue.get(block=True)
-            queue.task_done()
+        while True:
+            if self._stop_requested:
+                chunk = None  # terminate(): consume no further data
+                break
+            try:
+                chunk = self._get_once(timeout_ms=100)
+                break
+            except TimeoutError:
+                continue
         if t0 is not None:
             self.metrics.infeed_wait(time.perf_counter() - t0)
         return chunk
@@ -205,7 +232,11 @@ class DataFeed:
 
         Sets state to 'terminating' so feeder tasks that land later skip
         straight to draining; then empties what is already queued so the
-        producer's queue.join() returns.
+        producer's queue.join() returns.  Safe to call while another
+        thread (e.g. the infeed prefetcher) is blocked in next_batch: the
+        stop flag turns that thread's pending get into end-of-feed, and
+        all pops here go through the same per-attempt lock, so the
+        single-consumer ring never sees two concurrent readers.
 
         Ring path: "drained" is decided by the producer flock, not a
         timeout — an empty ring only ends the drain once no feeder holds
@@ -213,27 +244,31 @@ class DataFeed:
         data (and its _await_consumption) behind a 5s guess.
         """
         logger.info("terminate() invoked")
+        self._stop_requested = True
         self.mgr.set("state", "terminating")
         if self._ring is not None:
             from tensorflowonspark_tpu.recordio import shm as shmq
 
+            empty_checks = 0
             while True:
                 try:
-                    if self._ring.get(timeout_ms=1000) is None:
+                    if self._get_once(timeout_ms=1000) is None:
                         break  # producer closed the ring: EOF
+                    empty_checks = 0
                 except TimeoutError:
                     if (self._ring.qsize_bytes() == 0
                             and not shmq.producer_active(self._ring.name)):
-                        break
+                        empty_checks += 1
+                        if empty_checks >= 2:
+                            break
             return
-        done = False
-        while not done:
+        while True:
             try:
-                queue = self.mgr.get_queue(self.qname_in)
-                queue.get(block=True, timeout=5)
-                queue.task_done()
-            except Exception:  # noqa: BLE001 - Empty/Timeout = fully drained
-                done = True
+                self._get_once(timeout_ms=5000)
+            except Exception:  # noqa: BLE001 - Empty/Timeout/dead manager
+                # = fully drained: a manager already torn down at job end
+                # must not crash an otherwise-successful terminate
+                break
 
 
 def start_cluster_server(ctx, num_gpus=1, rdma=False):

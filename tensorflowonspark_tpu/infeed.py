@@ -16,6 +16,7 @@ from __future__ import annotations
 import logging
 import queue as _queue
 import threading
+import time as _time
 
 logger = logging.getLogger(__name__)
 
@@ -59,11 +60,14 @@ def prefetch_to_device(it, depth=2, placement=None):
         place = placement
 
     q = _queue.Queue(maxsize=depth)
+    cancelled = threading.Event()
 
     def worker():
         try:
             for batch in it:
                 q.put(place(batch))
+                if cancelled.is_set():
+                    break
         except Exception as e:  # noqa: BLE001 - forwarded to consumer
             q.put(("__prefetch_error__", e))
         finally:
@@ -72,14 +76,36 @@ def prefetch_to_device(it, depth=2, placement=None):
     t = threading.Thread(target=worker, daemon=True, name="tfos-prefetch")
     t.start()
 
-    while True:
-        item = q.get()
-        if item is _END:
-            return
-        if isinstance(item, tuple) and len(item) == 2 \
-                and item[0] == "__prefetch_error__":
-            raise item[1]
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, tuple) and len(item) == 2 \
+                    and item[0] == "__prefetch_error__":
+                raise item[1]
+            yield item
+    finally:
+        # abandoned mid-stream (consumer .close() / early stop): release
+        # a worker blocked on the full queue and drop its staged batches
+        # so they don't pin device memory.  Bounded: a worker blocked
+        # inside the source iterator (no feed.terminate() was issued)
+        # cannot be interrupted — leave it as a daemon rather than wedge.
+        cancelled.set()
+        deadline = _time.monotonic() + 10
+        while _time.monotonic() < deadline:
+            try:
+                item = q.get(timeout=0.2)
+            except _queue.Empty:
+                if not t.is_alive():
+                    break
+                continue
+            if item is _END:
+                break
+        t.join(timeout=5)
+        if t.is_alive():
+            logger.warning("prefetch worker still blocked in the source "
+                           "iterator; left as daemon")
 
 
 def synchronized(it, feed=None):
@@ -131,7 +157,10 @@ def synchronized(it, feed=None):
                     "remainder"
                 )
                 if feed is not None:
-                    feed.terminate()
+                    feed.terminate()  # unblocks + ends the batch stream
+                close = getattr(it, "close", None)
+                if close is not None:
+                    close()  # reap the prefetch thread + staged batches
             return
         yield item
 

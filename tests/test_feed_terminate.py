@@ -91,6 +91,62 @@ def test_terminate_waits_for_slow_producer():
         ring.close()
 
 
+def test_terminate_while_prefetch_thread_blocked():
+    """terminate() from the main thread while a prefetch thread is blocked
+    inside next_batch must not race the single-consumer ring: the blocked
+    get turns into end-of-feed and the drain proceeds under the shared
+    lock (the infeed.synchronized early-stop path)."""
+    from tensorflowonspark_tpu.feed import DataFeed
+    from tensorflowonspark_tpu.infeed import batch_iterator
+
+    name = f"/tfosq-term-{os.getpid()}-d"
+    ring = shm.ShmQueue(name, capacity=1 << 16, create=True)
+    mgr = FakeMgr({"shm_input": name})
+    prod = shm.ShmQueue(name, create=False, producer=True)
+    try:
+        for i in range(3):
+            prod.put([(float(i),)] * 8)
+
+        feed = DataFeed(mgr)
+        got = []
+        done = threading.Event()
+
+        def consume():
+            # 8-record batches: consumes the 3 chunks then BLOCKS on the
+            # empty ring (no end-of-feed None was sent)
+            for b in batch_iterator(feed, 8):
+                got.append(b)
+            done.set()
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        deadline = time.time() + 10
+        while len(got) < 3 and time.time() < deadline:
+            time.sleep(0.05)
+        assert len(got) == 3, got
+        # consumer is now blocked inside _get_chunk; terminate
+        # concurrently while the producer is still mid-partition
+        term_done = threading.Event()
+
+        def do_term():
+            feed.terminate()
+            term_done.set()
+
+        tt = threading.Thread(target=do_term, daemon=True)
+        tt.start()
+        time.sleep(0.4)  # flag set; consumer has left its pending get
+        prod.put([(99.0,)] * 8)  # data the drain must absorb, not consume
+        prod.close()  # release the flock so the drain can finish
+        assert term_done.wait(10), "terminate did not finish draining"
+        assert done.wait(5), "prefetch thread did not exit after terminate"
+        assert len(got) == 3  # post-terminate data was drained, not consumed
+        assert feed.should_stop()
+        assert ring.qsize_bytes() == 0
+    finally:
+        prod.close()
+        ring.close()
+
+
 def test_feeder_put_bails_on_termination(monkeypatch):
     """A feeder blocked on a full ring notices state='terminating' and
     returns instead of deadlocking against a consumer that stopped
