@@ -55,7 +55,7 @@ def test_datafeed_accounts_infeed_wait():
         def __init__(self, items):
             self.items = list(items)
 
-        def get(self, block=True):
+        def get(self, block=True, timeout=None):
             time.sleep(0.005)
             return self.items.pop(0)
 
