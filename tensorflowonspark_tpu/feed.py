@@ -128,14 +128,19 @@ class DataFeed:
 
     def _get_chunk(self):
         """Next chunk from the fast or compat transport: blocks until data
-        arrives or terminate() is requested (then reports end-of-feed)."""
+        arrives or terminate()/poison() is requested (then reports
+        end-of-feed).  Poll slice: 100ms on the in-process shm ring (a
+        local check), 1s on the manager-queue compat path where every
+        attempt is a proxy RPC — the stop flag only needs sub-second
+        responsiveness, not a 10Hz round-trip load on the manager."""
         t0 = time.perf_counter() if self.metrics is not None else None
+        slice_ms = 100 if self._ring is not None else 1000
         while True:
             if self._stop_requested:
                 chunk = None  # terminate(): consume no further data
                 break
             try:
-                chunk = self._get_once(timeout_ms=100)
+                chunk = self._get_once(timeout_ms=slice_ms)
                 break
             except TimeoutError:
                 continue
@@ -226,6 +231,15 @@ class DataFeed:
         """Push one batch of inference results (TFNode.py:294-305)."""
         queue = self.mgr.get_queue(self.qname_out)
         queue.put(list(results))
+
+    def poison(self):
+        """End the feed for its consumer without the producer handshake:
+        the next _get_chunk poll reports end-of-feed.  Used when a
+        prefetch worker is abandoned mid-stream (infeed.py) so the orphan
+        thread exits within one poll slice instead of polling forever;
+        the ring stays single-consumer and terminate() may still run the
+        full producer drain afterwards."""
+        self._stop_requested = True
 
     def terminate(self):
         """Request early stop and drain the input queue (TFNode.py:307-329).

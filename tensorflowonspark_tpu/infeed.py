@@ -41,13 +41,19 @@ def batch_iterator(feed, batch_size, collate=None, min_batch=None):
         yield collate(records) if collate is not None else records
 
 
-def prefetch_to_device(it, depth=2, placement=None):
+def prefetch_to_device(it, depth=2, placement=None, on_abandon=None):
     """Stage ``it``'s batches onto devices ``depth`` ahead.
 
     placement: None (default device_put), a Sharding, or a callable
     pytree->pytree (e.g. ``lambda b: local_to_global(mesh, b)`` for
     multi-host global arrays).  Exceptions on the worker thread re-raise
     at the consuming iteration.
+
+    on_abandon: called once if the consumer abandons the stream while the
+    worker is still running (early ``break`` / ``close()``) — its job is
+    to make the source iterator return promptly (device_feed passes the
+    DataFeed's ``poison``).  Without it, a worker blocked in the source
+    cannot be interrupted and is left as a daemon.
     """
     import jax
 
@@ -65,9 +71,19 @@ def prefetch_to_device(it, depth=2, placement=None):
     def worker():
         try:
             for batch in it:
-                q.put(place(batch))
+                # check before place(): a cancelled worker must not stage
+                # one more batch into HBM just for the drain to discard it
                 if cancelled.is_set():
                     break
+                staged = place(batch)
+                # re-check after place(): the consumer may have abandoned
+                # the stream during a long transfer — dropping the local
+                # reference frees the device buffer, whereas enqueueing it
+                # into the abandoned queue would pin HBM indefinitely
+                if cancelled.is_set():
+                    del staged
+                    break
+                q.put(staged)
         except Exception as e:  # noqa: BLE001 - forwarded to consumer
             q.put(("__prefetch_error__", e))
         finally:
@@ -76,36 +92,56 @@ def prefetch_to_device(it, depth=2, placement=None):
     t = threading.Thread(target=worker, daemon=True, name="tfos-prefetch")
     t.start()
 
+    finished = False
     try:
         while True:
             item = q.get()
             if item is _END:
+                finished = True
                 return
             if isinstance(item, tuple) and len(item) == 2 \
                     and item[0] == "__prefetch_error__":
                 raise item[1]
             yield item
     finally:
-        # abandoned mid-stream (consumer .close() / early stop): release
-        # a worker blocked on the full queue and drop its staged batches
-        # so they don't pin device memory.  Bounded: a worker blocked
-        # inside the source iterator (no feed.terminate() was issued)
-        # cannot be interrupted — leave it as a daemon rather than wedge.
         cancelled.set()
-        deadline = _time.monotonic() + 10
-        while _time.monotonic() < deadline:
-            try:
-                item = q.get(timeout=0.2)
-            except _queue.Empty:
-                if not t.is_alive():
+        if not finished:
+            # abandoned mid-stream (or error raised): ask the source to
+            # unblock, release a worker blocked on the full queue, and
+            # drop staged batches so they don't pin device memory
+            if on_abandon is not None:
+                try:
+                    on_abandon()
+                except Exception:  # noqa: BLE001 - cleanup must not mask
+                    logger.exception("prefetch on_abandon hook failed")
+            deadline = _time.monotonic() + 3
+            idle_polls = 0
+            while _time.monotonic() < deadline:
+                try:
+                    item = q.get(timeout=0.2)
+                except _queue.Empty:
+                    if not t.is_alive():
+                        break
+                    # a live-but-idle worker is blocked in the source and
+                    # will never produce once cancelled: stop burning time
+                    idle_polls += 1
+                    if idle_polls >= 2 and on_abandon is None:
+                        break
+                    continue
+                idle_polls = 0
+                if item is _END:
                     break
-                continue
-            if item is _END:
-                break
-        t.join(timeout=5)
+        t.join(timeout=2)
         if t.is_alive():
-            logger.warning("prefetch worker still blocked in the source "
-                           "iterator; left as daemon")
+            logger.warning("prefetch worker did not exit (blocked in the "
+                           "source iterator or mid-transfer); left as daemon")
+        # final sweep: drop anything enqueued between the drain loop's
+        # last poll and the worker's exit so it doesn't pin device memory
+        while True:
+            try:
+                q.get_nowait()
+            except _queue.Empty:
+                break
 
 
 def synchronized(it, feed=None):
@@ -179,4 +215,8 @@ def device_feed(feed, batch_size, *, collate=None, depth=2, placement=None,
         batch_iterator(feed, batch_size, collate, min_batch),
         depth=depth,
         placement=placement,
+        # abandoning the stream (early break / close) poisons the feed so
+        # the prefetch worker exits instead of polling the ring forever;
+        # call feed.terminate() afterwards for the producer-drain handshake
+        on_abandon=getattr(feed, "poison", None),
     )
