@@ -135,6 +135,41 @@ def _bind(lib):
     lib.tfr_crc32c.restype = c.c_uint32
     lib.tfr_crc32c.argtypes = [c.c_char_p, c.c_uint64]
 
+    # columnar bulk loader (round 3+; callers check lib._tfos_colb_api)
+    try:
+        lib.tfr_load_columnar.restype = c.c_void_p
+        lib.tfr_load_columnar.argtypes = [c.c_char_p]
+        lib.tfr_load_columnar_mem.restype = c.c_void_p
+        lib.tfr_load_columnar_mem.argtypes = [c.c_char_p, c.c_uint64]
+        lib.colb_ok.restype = c.c_int
+        lib.colb_ok.argtypes = [c.c_void_p]
+        lib.colb_error.restype = c.c_char_p
+        lib.colb_error.argtypes = [c.c_void_p]
+        lib.colb_num_rows.restype = c.c_int64
+        lib.colb_num_rows.argtypes = [c.c_void_p]
+        lib.colb_num_features.restype = c.c_int
+        lib.colb_num_features.argtypes = [c.c_void_p]
+        lib.colb_name.restype = c.c_char_p
+        lib.colb_name.argtypes = [c.c_void_p, c.c_int]
+        lib.colb_kind.restype = c.c_int
+        lib.colb_kind.argtypes = [c.c_void_p, c.c_int]
+        lib.colb_width.restype = c.c_int64
+        lib.colb_width.argtypes = [c.c_void_p, c.c_int]
+        lib.colb_floats.restype = c.POINTER(c.c_float)
+        lib.colb_floats.argtypes = [c.c_void_p, c.c_int]
+        lib.colb_int64s.restype = c.POINTER(c.c_int64)
+        lib.colb_int64s.argtypes = [c.c_void_p, c.c_int]
+        lib.colb_bytes_blob.restype = u8p
+        lib.colb_bytes_blob.argtypes = [c.c_void_p, c.c_int]
+        lib.colb_bytes_offsets.restype = c.POINTER(c.c_uint64)
+        lib.colb_bytes_offsets.argtypes = [c.c_void_p, c.c_int]
+        lib.colb_free.argtypes = [c.c_void_p]
+        lib._tfos_colb_api = True
+    except AttributeError:
+        logger.warning("native lib lacks the columnar API (stale build); "
+                       "bulk TFRecord loads will decode per row")
+        lib._tfos_colb_api = False
+
     # memory-buffer framing (remote-FS path: fsspec moves the bytes,
     # the C library still does framing + crc); absent in pre-round-3 .so
     # builds — callers check lib._tfos_mem_api and fall back to pyimpl
