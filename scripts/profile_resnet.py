@@ -9,10 +9,13 @@ tensorflow xplane protobuf (no TensorBoard needed).
 import argparse
 import glob
 import os
+import sys
 import time
 from collections import defaultdict
 
-import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
 
 
 def parse_xplane(logdir):
@@ -50,7 +53,10 @@ def main():
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--image", type=int, default=224)
-    ap.add_argument("--s2d", action="store_true", help="space-to-depth stem")
+    ap.add_argument("--stem", choices=("s2d", "7x7"), default="s2d")
+    ap.add_argument("--remat", action="store_true")
+    ap.add_argument("--out", default=None,
+                    help="also write the breakdown as markdown (e.g. PERF.md)")
     args = ap.parse_args()
 
     import jax
@@ -71,7 +77,13 @@ def main():
         return params, state, opt.init(params)
 
     params, state, opt_state = init_all(jax.random.PRNGKey(0))
-    step_fn = resnet.make_train_step(opt, depth=50)
+    # apply() silently falls back to 7x7 on odd image sizes — report the
+    # stem that actually runs, not the one requested
+    effective_stem = ("s2d" if args.stem == "s2d" and args.image % 2 == 0
+                      else "7x7")
+    step_fn = resnet.make_train_step(opt, depth=50,
+                                     stem_s2d=(args.stem == "s2d"),
+                                     remat=args.remat)
 
     rng = np.random.default_rng(0)
     images = jnp.asarray(rng.random((args.batch, args.image, args.image, 3),
@@ -106,19 +118,40 @@ def main():
     jax.profiler.stop_trace()
 
     xspace = parse_xplane(logdir)
+    report = [f"# ResNet-50 step-time breakdown",
+              f"",
+              f"batch={args.batch} image={args.image} stem={effective_stem} "
+              f"remat={args.remat} steps={args.steps}; "
+              f"measured {ms_per_step:.1f} ms/step "
+              f"({args.batch / (ms_per_step / 1000):.0f} img/s).",
+              ""]
     for plane_name, totals, counts in summarize(xspace):
         total = sum(totals.values())
         print(f"\n== {plane_name}  total {total:.1f}ms over {args.steps} steps ==")
+        report += [f"## {plane_name} — {total:.1f} ms device time "
+                   f"over {args.steps} steps", ""]
         # group by fusion-kind prefix
         groups = defaultdict(float)
         for name, ms in totals.items():
             key = name.split(".")[0].split("_")[0]
             groups[key] += ms
+        report += ["| op group | ms | % |", "|---|---|---|"]
         for k, v in sorted(groups.items(), key=lambda kv: -kv[1])[:15]:
             print(f"  [group] {k:30s} {v:8.2f}ms {100 * v / total:5.1f}%")
+            report.append(f"| {k} | {v:.2f} | {100 * v / total:.1f} |")
         print()
+        report += ["", "| top op | ms | n | % |", "|---|---|---|---|"]
         for name, ms in sorted(totals.items(), key=lambda kv: -kv[1])[:40]:
             print(f"  {ms:8.2f}ms x{counts[name]:<4d} {100 * ms / total:5.1f}%  {name[:110]}")
+            report.append(f"| `{name[:90]}` | {ms:.2f} | {counts[name]} "
+                          f"| {100 * ms / total:.1f} |")
+        report.append("")
+    if args.out:
+        from tensorflowonspark_tpu.recordio import fs as _fs
+
+        with _fs.open_file(args.out, "w") as f:
+            f.write("\n".join(report) + "\n")
+        print(f"\nwrote {args.out}")
 
 
 if __name__ == "__main__":
