@@ -71,12 +71,13 @@ def main():
     print("init done", flush=True)
 
     configs = [
-        # (name, batch, stem_s2d, remat)
-        ("b256_s2d", 256, True, False),
-        ("b256_7x7", 256, False, False),
+        # (name, batch, stem_s2d, remat) — most promising first, so a
+        # flaky tunnel session still yields the configs that matter
         ("b512_s2d", 512, True, False),
+        ("b256_s2d", 256, True, False),
         ("b512_s2d_remat", 512, True, True),
         ("b1024_s2d_remat", 1024, True, True),
+        ("b256_7x7", 256, False, False),
     ]
     subset = os.environ.get("TFOS_SWEEP")
     if subset:
