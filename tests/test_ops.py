@@ -88,3 +88,17 @@ def test_fused_rmsnorm_matches_reference_and_grads():
                   argnums=(0, 1))(x, scale)
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+@pytest.mark.parametrize("bq,bkv", [(512, 512), (1024, 512), (512, 1024),
+                                    (1024, 1024)])
+def test_flash_sweep_blocks_at_seq2048(bq, bkv):
+    """The exact block combos scripts/sweep_transformer.py runs at seq
+    2048: validates the block-dependent masking/online-softmax logic in
+    interpret mode.  (TPU-only failure modes — Mosaic tiling limits,
+    VMEM overflow at the sweep's real d=128 bf16 shapes — can only
+    surface on the chip.)"""
+    q, k, v = _qkv(jax.random.PRNGKey(1), 1, 2048, 1, 8)
+    ref = ops.mha_reference(q, k, v, causal=True)
+    out = ops.flash_attention(q, k, v, causal=True, block_q=bq, block_kv=bkv)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
