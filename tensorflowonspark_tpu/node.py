@@ -160,6 +160,28 @@ class TFNodeContext:
             process_id=env["process_id"],
         )
         self._jax_distributed = True
+        # slice health at bring-up (SURVEY.md §5): a process that joined
+        # the job but sees a wedged chip or a short device count should
+        # say so here, where the error queue still reaches the driver,
+        # not via a hang in the first collective
+        from tensorflowonspark_tpu import tpu_info
+
+        health = tpu_info.slice_health(
+            expected_processes=env["num_processes"])
+        env["slice_health"] = health
+        if not health["healthy"]:
+            logger.error("slice health check failed: %s", health["errors"])
+            # raising here routes through the node wrapper's exception
+            # path onto the error queue, which the feeder/driver observe;
+            # TFOS_SLICE_HEALTH=warn downgrades to the log line only
+            if os.environ.get("TFOS_SLICE_HEALTH", "strict") != "warn":
+                raise RuntimeError(
+                    f"unhealthy accelerator slice: {health['errors']}")
+        else:
+            logger.info(
+                "slice healthy: %d local / %d global devices (%s)",
+                health["local_devices"], health["global_devices"],
+                health["platform"])
         return env
 
     def sync_exit_barrier(self):

@@ -205,3 +205,94 @@ def local_device_info():
     except Exception as e:  # noqa: BLE001 - discovery is best-effort
         logger.debug("no live jax backend for device info: %s", e)
         return []
+
+
+def slice_health(expected_processes=None, expected_local_devices=None,
+                 smoke=True, timeout=60):
+    """Health-check the accelerator slice from a live JAX backend.
+
+    The new-build counterpart of the reference's implicit "TF server came
+    up" signal (SURVEY.md §5: recovery remains restart-from-checkpoint,
+    *plus TPU-slice health checks*): after ``ctx.jax_initialize()`` every
+    process can verify that (a) it sees its local chips, (b) the global
+    device count matches processes x local devices, and (c) a trivial
+    computation executes on every local device.  Returns a dict with
+    ``healthy`` plus details; never raises and never hangs past
+    ``timeout`` — callers decide whether a sick slice is fatal.
+    """
+    import threading
+
+    report = {
+        "healthy": False,
+        "platform": None,
+        "local_devices": 0,
+        "global_devices": 0,
+        "process_index": None,
+        "errors": [],
+    }
+
+    # the whole probe runs on a bounded worker: on a wedged backend the
+    # FIRST jax call (backend-client creation) is a common hang point,
+    # not just the smoke compute — a hang must become a report, not
+    # wedge bring-up
+    def probe():
+        try:
+            import jax
+
+            devs = jax.local_devices()
+            report["platform"] = devs[0].platform if devs else None
+            report["local_devices"] = len(devs)
+            report["global_devices"] = jax.device_count()
+            report["process_index"] = jax.process_index()
+            if not devs:
+                report["errors"].append("no local devices visible")
+                return
+            if report["platform"] == "cpu" and count_chips() > 0:
+                # libtpu failed to load and jax silently fell back to
+                # host CPU — counts all match, but this is not the slice
+                report["errors"].append(
+                    f"{count_chips()} TPU chips present on this host but "
+                    "the jax backend is 'cpu' (accelerator runtime failed "
+                    "to initialize?)")
+            if expected_local_devices is not None and \
+                    len(devs) != expected_local_devices:
+                report["errors"].append(
+                    f"local devices {len(devs)} != expected "
+                    f"{expected_local_devices}")
+            if expected_processes is not None and \
+                    jax.process_count() != expected_processes:
+                report["errors"].append(
+                    f"process count {jax.process_count()} != expected "
+                    f"{expected_processes}")
+            # global cross-check: slices are homogeneous, so even without
+            # an explicit expectation a peer host that came up short shows
+            # as global != processes x local
+            want = ((expected_processes or jax.process_count())
+                    * (expected_local_devices or len(devs)))
+            if report["global_devices"] != want:
+                report["errors"].append(
+                    f"global devices {report['global_devices']} != expected "
+                    f"{want} (a peer host may be short of chips)")
+            if smoke:
+                import numpy as np
+
+                # a tiny add on each local device proves the runtime
+                # executes (a wedged chip typically hangs or errors here)
+                for d in devs:
+                    got = jax.device_put(np.int32(20), d) + 22
+                    if int(got) != 42:
+                        report["errors"].append(
+                            f"device {d.id} smoke compute returned "
+                            f"{int(got)}")
+        except Exception as e:  # noqa: BLE001 - report, never raise
+            report["errors"].append(f"{type(e).__name__}: {str(e)[:160]}")
+
+    t = threading.Thread(target=probe, daemon=True, name="tfos-slice-health")
+    t.start()
+    t.join(timeout=timeout)
+    if t.is_alive():
+        report["errors"].append(
+            f"health probe still hung after {timeout}s (wedged backend "
+            "or device?)")
+    report["healthy"] = not report["errors"]
+    return report

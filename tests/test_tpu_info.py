@@ -147,3 +147,52 @@ def test_no_pyspark_resource_api_probe():
     assert tpu_info._task_resources() is None or isinstance(
         tpu_info._task_resources(), dict
     )
+
+
+def test_slice_health_on_live_backend():
+    """On the 8-device virtual CPU platform: healthy, counts match, and
+    expectation mismatches are reported without raising."""
+    from tensorflowonspark_tpu import tpu_info
+
+    h = tpu_info.slice_health(expected_processes=1,
+                              expected_local_devices=8)
+    assert h["healthy"], h
+    assert h["local_devices"] == 8 and h["global_devices"] == 8
+    assert h["platform"] == "cpu"
+
+    sick = tpu_info.slice_health(expected_processes=2,
+                                 expected_local_devices=4)
+    assert not sick["healthy"]
+    assert any("process count" in e for e in sick["errors"])
+    assert any("local devices" in e for e in sick["errors"])
+
+
+def test_unhealthy_slice_is_fatal_at_bring_up(monkeypatch):
+    """jax_initialize must RAISE on an unhealthy slice (routing through
+    the node wrapper's error queue), unless TFOS_SLICE_HEALTH=warn."""
+    import pytest
+
+    from tensorflowonspark_tpu import node as N
+    from tensorflowonspark_tpu import tpu_info
+
+    ctx = N.TFNodeContext.__new__(N.TFNodeContext)
+    monkeypatch.setattr(
+        N.TFNodeContext, "distributed_env",
+        lambda self: {"num_processes": 2, "process_id": 0,
+                      "coordinator_address": "127.0.0.1:1"})
+
+    import jax.distributed
+
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: None)
+    sick = {"healthy": False, "errors": ["device 0 smoke hung"],
+            "local_devices": 0, "global_devices": 0, "platform": None,
+            "process_index": None}
+    monkeypatch.setattr(tpu_info, "slice_health", lambda **kw: sick)
+
+    with pytest.raises(RuntimeError, match="unhealthy accelerator slice"):
+        ctx.jax_initialize()
+
+    monkeypatch.setenv("TFOS_SLICE_HEALTH", "warn")
+    env = N.TFNodeContext.jax_initialize(ctx)
+    assert env["slice_health"] is sick  # reported, not fatal
