@@ -56,25 +56,29 @@ def main():
     print("init done", flush=True)
 
     configs = [
-        # (name, batch, block_q, block_kv)
-        ("b8_q512_kv512", 8, 512, 512),
-        ("b16_q512_kv512", 16, 512, 512),
-        ("b16_q1024_kv512", 16, 1024, 512),
-        ("b16_q512_kv1024", 16, 512, 1024),
-        ("b16_q1024_kv1024", 16, 1024, 1024),
-        ("b32_q512_kv512", 32, 512, 512),
+        # (name, batch, block_q, block_kv, remat)
+        ("b16_q512_kv512", 16, 512, 512, False),
+        ("b8_q512_kv512", 8, 512, 512, False),
+        ("b16_q1024_kv512", 16, 1024, 512, False),
+        ("b16_q512_kv1024", 16, 512, 1024, False),
+        ("b16_q1024_kv1024", 16, 1024, 1024, False),
+        ("b32_q512_kv512", 32, 512, 512, False),
+        ("b32_q512_kv512_remat", 32, 512, 512, True),
+        ("b64_q512_kv512_remat", 64, 512, 512, True),
     ]
     subset = os.environ.get("TFOS_SWEEP")
     if subset:
         want = set(subset.split(","))
         configs = [c for c in configs if c[0] in want]
-    if smoke:  # plumbing check (CPU): tiny batch, blocks fitting max_seq
-        configs = [(n, 1, min(bq, 128), min(bkv, 128))
-                   for n, _, bq, bkv in configs[:2]]
+    if smoke:  # plumbing check (CPU): tiny batch, blocks fitting max_seq,
+        # always including one remat config so that plumbing is exercised
+        picked = configs[:2] + [c for c in configs[2:] if c[4]][:1]
+        configs = [(n, 1, min(bq, 128), min(bkv, 128), r)
+                   for n, _, bq, bkv, r in picked]
 
     rng = np.random.default_rng(0)
     results = []
-    for name, batch, bq, bkv in configs:
+    for name, batch, bq, bkv, remat in configs:
         try:
             tokens = jnp.asarray(
                 rng.integers(0, cfg.vocab_size, (batch, cfg.max_seq)),
@@ -87,7 +91,7 @@ def main():
                 def body(carry, _):
                     p, o = carry
                     loss, grads = jax.value_and_grad(transformer.loss_fn)(
-                        p, tokens, cfg, attn_fn=attn)
+                        p, tokens, cfg, attn_fn=attn, remat=remat)
                     updates, o = opt.update(grads, o)
                     return (optax.apply_updates(p, updates), o), loss
                 (_, _), losses = lax.scan(

@@ -147,19 +147,23 @@ class TFNodeContext:
     def jax_initialize(self):
         """Join the multi-controller JAX job (TF_CONFIG/MWMS replacement).
 
-        No-op for single-process clusters and for ps/evaluator roles.
+        No-op for ps/evaluator roles (they own no chips).  Single-process
+        jobs skip jax.distributed but still run the slice health check —
+        the silent libtpu-fallback (training on host CPU) is most common
+        exactly there.
         """
         env = self.distributed_env()
-        if env["num_processes"] <= 1 or env["process_id"] is None:
+        if env["process_id"] is None:  # ps/evaluator: no accelerator claim
             return env
-        import jax
+        if env["num_processes"] > 1:
+            import jax
 
-        jax.distributed.initialize(
-            coordinator_address=env["coordinator_address"],
-            num_processes=env["num_processes"],
-            process_id=env["process_id"],
-        )
-        self._jax_distributed = True
+            jax.distributed.initialize(
+                coordinator_address=env["coordinator_address"],
+                num_processes=env["num_processes"],
+                process_id=env["process_id"],
+            )
+            self._jax_distributed = True
         # slice health at bring-up (SURVEY.md §5): a process that joined
         # the job but sees a wedged chip or a short device count should
         # say so here, where the error queue still reaches the driver,

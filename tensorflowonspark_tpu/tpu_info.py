@@ -247,9 +247,15 @@ def slice_health(expected_processes=None, expected_local_devices=None,
             if not devs:
                 report["errors"].append("no local devices visible")
                 return
-            if report["platform"] == "cpu" and count_chips() > 0:
+            forced_cpu = os.environ.get(
+                "JAX_PLATFORMS", "").lower() == "cpu"
+            if report["platform"] == "cpu" and not forced_cpu \
+                    and count_chips() > 0:
                 # libtpu failed to load and jax silently fell back to
-                # host CPU — counts all match, but this is not the slice
+                # host CPU — counts all match, but this is not the slice.
+                # An explicit JAX_PLATFORMS=cpu is an intentional choice
+                # (tests run forced-cpu on TPU VMs while a bench owns the
+                # chips), not a fallback.
                 report["errors"].append(
                     f"{count_chips()} TPU chips present on this host but "
                     "the jax backend is 'cpu' (accelerator runtime failed "

@@ -196,3 +196,21 @@ def test_unhealthy_slice_is_fatal_at_bring_up(monkeypatch):
     monkeypatch.setenv("TFOS_SLICE_HEALTH", "warn")
     env = N.TFNodeContext.jax_initialize(ctx)
     assert env["slice_health"] is sick  # reported, not fatal
+
+
+def test_slice_health_flags_silent_cpu_fallback(monkeypatch):
+    """TPU chips present + jax backend 'cpu' without an explicit
+    JAX_PLATFORMS=cpu means the accelerator runtime failed to load —
+    must be unhealthy.  An explicit cpu platform (this test suite's own
+    environment) is intentional and stays healthy."""
+    from tensorflowonspark_tpu import tpu_info
+
+    monkeypatch.setattr(tpu_info, "count_chips", lambda: 4)
+    # conftest sets JAX_PLATFORMS=cpu -> intentional, healthy
+    assert tpu_info.slice_health(expected_processes=1,
+                                 expected_local_devices=8)["healthy"]
+    monkeypatch.setenv("JAX_PLATFORMS", "")
+    sick = tpu_info.slice_health(expected_processes=1,
+                                 expected_local_devices=8)
+    assert not sick["healthy"]
+    assert any("accelerator runtime" in e for e in sick["errors"])

@@ -106,3 +106,31 @@ def test_sharded_train_step_4axis_mesh(eight_devices, attn):
         jax.device_get(params), jax.device_get(toks), CFG
     )
     np.testing.assert_allclose(float(loss), float(ref_loss), atol=3e-4)
+
+
+def test_remat_matches_no_remat():
+    """jax.checkpoint over the scanned layer must not change loss or
+    gradients (it only changes what the backward pass keeps resident)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflowonspark_tpu.models import transformer
+
+    cfg = transformer.Config(vocab_size=128, dim=64, n_layers=2, n_heads=2,
+                             max_seq=32, dtype="float32",
+                             attn_impl="reference")
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 128, (2, 32)), jnp.int32)
+
+    base_loss, base_grads = jax.value_and_grad(transformer.loss_fn)(
+        params, tokens, cfg)
+    r_loss, r_grads = jax.value_and_grad(
+        lambda p, t: transformer.loss_fn(p, t, cfg, remat=True))(
+        params, tokens)
+    np.testing.assert_allclose(float(base_loss), float(r_loss), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        base_grads, r_grads)
