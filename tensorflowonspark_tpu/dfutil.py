@@ -179,19 +179,29 @@ def load_tfrecords(source, input_dir, binary_features=()):
     return ds, schema
 
 
-def load_tfrecords_columnar(input_dir):
-    """Bulk-load a TFRecord dir into dense per-feature columns:
+def load_tfrecords_columnar(source):
+    """Bulk-load TFRecords into dense per-feature columns:
     {name: ndarray [n]/[n,w] or list-of-bytes} — the TPU-first fast path
     for InputMode.TENSORFLOW-style direct reads (one C pass per shard, no
     per-value Python objects; columns np-slice straight into device
     batches).  Row-level parity lives in ``load_tfrecords``; this is the
     bulk analogue of the reference's Hadoop TFRecordFileInputFormat scan
     (dfutil.py:44-81 via the tensorflow-hadoop jar).
+
+    ``source``: a dir (its part files), a single file path, or an
+    explicit list of paths (e.g. one worker's disjoint shard subset).
+    Empty shards are skipped; cross-shard dtype/width drift errors.
     """
     import numpy as np
 
-    files = _part_files(input_dir)
-    shards = [recordio.load_columnar(f) for f in files]
+    files = source if isinstance(source, (list, tuple)) \
+        else _part_files(source)
+    pairs = [(f, s) for f in files
+             if (s := recordio.load_columnar(f))]  # skip empty parts
+    if not pairs:
+        return {}
+    files = [f for f, _ in pairs]
+    shards = [s for _, s in pairs]
 
     def signature(shard):
         # name -> (kind, dtype, trailing shape) — dtype/width drift across

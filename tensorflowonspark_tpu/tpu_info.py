@@ -247,8 +247,11 @@ def slice_health(expected_processes=None, expected_local_devices=None,
             if not devs:
                 report["errors"].append("no local devices visible")
                 return
-            forced_cpu = os.environ.get(
-                "JAX_PLATFORMS", "").lower() == "cpu"
+            plats = os.environ.get("JAX_PLATFORMS", "").lower()
+            forced_cpu = (
+                plats.split(",")[0].strip() == "cpu"  # incl. "cpu,tpu"
+                or os.environ.get("JAX_PLATFORM_NAME", "").lower() == "cpu"
+            )
             if report["platform"] == "cpu" and not forced_cpu \
                     and count_chips() > 0:
                 # libtpu failed to load and jax silently fell back to

@@ -257,3 +257,25 @@ def test_load_columnar_repeated_key_errors_cleanly(tmp_path):
     cols = recordio.load_columnar(str(path))
     # last-wins via the per-row fallback (dict semantics), never misaligned
     assert cols["x"][1].tolist() == [2]
+
+
+def test_dfutil_columnar_file_list_and_empty_shards(tmp_path):
+    d = tmp_path / "tfr"
+    d.mkdir()
+    _write_examples(d / "part-r-00000",
+                    [{"x": ("int64", [i])} for i in range(5)])
+    (d / "part-r-00001").write_bytes(b"")  # Hadoop-style empty part
+    _write_examples(d / "part-r-00002",
+                    [{"x": ("int64", [i])} for i in range(5, 8)])
+    # explicit file-subset form (a worker's disjoint shards)
+    cols = dfutil.load_tfrecords_columnar(
+        [str(d / "part-r-00000"), str(d / "part-r-00001")])
+    assert cols["x"].tolist() == list(range(5))
+    # dir form still skips the empty part and merges the rest
+    cols = dfutil.load_tfrecords_columnar(str(d))
+    assert sorted(cols["x"].tolist()) == list(range(8))
+    # all-empty yields an empty dict, not a crash
+    e = tmp_path / "empty"
+    e.mkdir()
+    (e / "part-r-00000").write_bytes(b"")
+    assert dfutil.load_tfrecords_columnar(str(e)) == {}
