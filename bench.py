@@ -217,11 +217,42 @@ def _on_tpu_guess():
     return bool(plat) or tpu_info.count_chips() > 0
 
 
+def _promoted_config():
+    """Optional bench_config.json at the repo root: the sweep's winning
+    ResNet configuration (scripts/sweep_resnet.py --promote), applied to
+    the TPU bench without code edits.  Env vars still win."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_config.json")
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as f:
+            cfg = json.load(f)
+        return cfg if isinstance(cfg, dict) else {}
+    except (OSError, ValueError) as e:
+        import sys
+
+        print(f"bench: ignoring unreadable bench_config.json: {e}",
+              file=sys.stderr, flush=True)
+        return {}
+
+
 def main():
     on_tpu = _on_tpu_guess()
-    batch = int(os.environ.get("TFOS_BENCH_BATCH", "256" if on_tpu else "16"))
-    image = int(os.environ.get("TFOS_BENCH_IMAGE", "224" if on_tpu else "64"))
+    promoted = _promoted_config() if on_tpu else {}
+    batch = int(os.environ.get(
+        "TFOS_BENCH_BATCH",
+        promoted.get("batch", 256) if on_tpu else 16))
+    image = int(os.environ.get(
+        "TFOS_BENCH_IMAGE",
+        promoted.get("image", 224) if on_tpu else 64))
     steps = int(os.environ.get("TFOS_BENCH_STEPS", "20" if on_tpu else "3"))
+    stem_s2d = os.environ.get(
+        "TFOS_BENCH_STEM_S2D",
+        "1" if promoted.get("stem_s2d", True) else "0") != "0"
+    remat = os.environ.get(
+        "TFOS_BENCH_REMAT",
+        "1" if promoted.get("remat", False) else "0") != "0"
 
     fed_ctx = None
     if os.environ.get("TFOS_BENCH_FED", "1") != "0":
@@ -281,7 +312,8 @@ def main():
         return params, state, opt.init(params)
 
     params, state, opt_state = init_all(jax.random.PRNGKey(0))
-    step_fn = resnet.make_train_step(opt, depth=50)
+    step_fn = resnet.make_train_step(opt, depth=50, stem_s2d=stem_s2d,
+                                     remat=remat)
 
     rng = np.random.default_rng(0)
     images = jnp.asarray(rng.random((batch, image, image, 3), dtype=np.float32),
@@ -313,6 +345,7 @@ def main():
     extra = {
         "images_per_sec_per_chip": round(imgs_per_sec, 1),
         "batch": batch, "image": image, "steps": steps,
+        "stem_s2d": stem_s2d, "remat": remat,
         "device": str(dev), "platform": dev.platform,
         "loss": loss,
     }

@@ -45,6 +45,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--image", type=int, default=224)
+    ap.add_argument("--promote", action="store_true",
+                    help="write the winning config to bench_config.json "
+                         "(picked up by bench.py on TPU)")
     args = ap.parse_args()
 
     import jax
@@ -88,6 +91,7 @@ def main():
 
     rng = np.random.default_rng(0)
     results = []
+    by_name = {}
     for name, batch, s2d, remat in configs:
         try:
             import jax.numpy as jnp
@@ -105,10 +109,28 @@ def main():
             print(f"{name:18s} step={sec*1e3:7.1f}ms  img/s={ips:7.0f}  "
                   f"mfu={mfu:.4f}  (compile {compile_s:.0f}s)", flush=True)
             results.append((mfu, name))
+            by_name[name] = {"batch": batch, "stem_s2d": s2d, "remat": remat}
         except Exception as e:  # noqa: BLE001 - keep sweeping
             print(f"{name:18s} FAILED: {str(e)[:160]}", flush=True)
     for mfu, name in sorted(results, reverse=True):
         print(f"  {mfu:.4f}  {name}")
+    if args.promote and results:
+        import json
+
+        if os.environ.get("TFOS_SWEEP_SMOKE") == "1" or \
+                dev.platform == "cpu":
+            print("promote skipped: smoke/CPU runs must not pin the TPU "
+                  "bench to toy shapes", flush=True)
+            return
+        best_mfu, best = max(results)
+        cfg = dict(by_name[best], image=args.image, winner=best,
+                   mfu=round(best_mfu, 4), device=str(dev))
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "bench_config.json")
+        with open(path, "w") as f:
+            json.dump(cfg, f, indent=1)
+        print(f"promoted {best} (mfu {best_mfu:.4f}) -> {path}", flush=True)
 
 
 if __name__ == "__main__":
