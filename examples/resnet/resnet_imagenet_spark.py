@@ -60,7 +60,8 @@ def main_fun(args, ctx):
         mesh, params, state, opt_state
     )
     step_fn = jax.jit(
-        resnet.make_train_step(opt, depth=50),
+        resnet.make_train_step(opt, depth=50,
+                               accum_steps=args.get("accum_steps", 1)),
         in_shardings=(p_sh, s_sh, o_sh, batch_sharding(mesh),
                       batch_sharding(mesh)),
         out_shardings=(p_sh, s_sh, o_sh, None, None),
@@ -163,6 +164,9 @@ def main():
     p.add_argument("--epochs", type=int, default=1)
     p.add_argument("--lr", type=float, default=0.1)
     p.add_argument("--save_every", type=int, default=200)
+    p.add_argument("--accum_steps", type=int, default=1,
+                   help="gradient-accumulation microbatches per step "
+                        "(effective batch beyond one chip's HBM)")
     p.add_argument("--data_dir", default=None,
                    help="TFRecord dir (file://, hdfs://, gs://)")
     p.add_argument("--model_dir", default="/tmp/resnet_imagenet")
@@ -192,7 +196,8 @@ def main():
         engine, main_fun,
         {"batch_size": args.batch_size, "lr": args.lr,
          "image_size": args.image_size, "num_classes": args.num_classes,
-         "model_dir": args.model_dir, "save_every": args.save_every},
+         "model_dir": args.model_dir, "save_every": args.save_every,
+         "accum_steps": args.accum_steps},
         num_executors=args.cluster_size, input_mode=InputMode.SPARK,
         master_node="chief",
     )

@@ -167,9 +167,19 @@ def apply(params, state, images, depth=50, train=True, small_inputs=False,
 
 
 def make_train_step(optimizer, depth=50, small_inputs=False,
-                    compute_dtype=jnp.bfloat16, remat=False, stem_s2d=True):
+                    compute_dtype=jnp.bfloat16, remat=False, stem_s2d=True,
+                    accum_steps=1):
     """(params, state, opt_state, images, labels) →
-    (params, state, opt_state, loss, acc); jittable, SPMD-ready."""
+    (params, state, opt_state, loss, acc); jittable, SPMD-ready.
+
+    ``accum_steps>1`` accumulates gradients over that many microbatches
+    under one jit (effective batch beyond HBM limits).  BatchNorm
+    normalizes each microbatch with its own statistics (as a sequential
+    small-batch loop would), so results are close to — not bit-identical
+    with — the one-big-batch step; the running-statistics EMA is
+    threaded through the chain and advances once per microbatch.
+    Accuracy is the last microbatch's.
+    """
 
     fwd = apply
     if remat:
@@ -182,13 +192,34 @@ def make_train_step(optimizer, depth=50, small_inputs=False,
         )
         return L.softmax_cross_entropy(logits, labels), (logits, new_state)
 
+    def value_and_grad(params, state, images, labels):
+        if accum_steps == 1:
+            return jax.value_and_grad(loss_fn, has_aux=True)(
+                params, state, images, labels)
+        from tensorflowonspark_tpu.utils.train import \
+            accumulated_value_and_grad
+
+        def micro_loss(p, aux_prev, x, y):
+            _, st = aux_prev  # BN running stats advance per microbatch
+            return loss_fn(p, st, x, y)
+
+        vg = accumulated_value_and_grad(micro_loss, accum_steps,
+                                        has_aux=True, carry_aux=True)
+        micro_b = images.shape[0] // accum_steps
+        logits0 = jnp.zeros((micro_b, params["fc"]["w"].shape[1]),
+                            jnp.float32)
+        return vg(params, images, labels, init_aux=(logits0, state))
+
     def train_step(params, state, opt_state, images, labels):
-        (loss, (logits, new_state)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(params, state, images, labels)
+        (loss, (logits, new_state)), grads = value_and_grad(
+            params, state, images, labels)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        return params, new_state, opt_state, loss, L.accuracy(logits, labels)
+        # accum path: logits/labels are the last microbatch's slice
+        acc_labels = (labels if accum_steps == 1
+                      else labels[-logits.shape[0]:])
+        return (params, new_state, opt_state, loss,
+                L.accuracy(logits, acc_labels))
 
     return train_step
 
