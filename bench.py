@@ -218,9 +218,12 @@ def _on_tpu_guess():
 
 
 def _promoted_config():
-    """Optional bench_config.json at the repo root: the sweep's winning
-    ResNet configuration (scripts/sweep_resnet.py --promote), applied to
-    the TPU bench without code edits.  Env vars still win."""
+    """Optional bench_config.json at the repo root: sweep winners
+    applied to the TPU bench without code edits.  Top-level keys are the
+    ResNet config (scripts/sweep_resnet.py --promote); the "transformer"
+    sub-dict is the transformer sweep's winner
+    (scripts/sweep_transformer.py --promote).  TFOS_BENCH_* env vars
+    still win over promoted values."""
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "bench_config.json")
     if not os.path.exists(path):
@@ -409,20 +412,35 @@ def _transformer_bench(dev, on_tpu):
     from tensorflowonspark_tpu.models import transformer
     from tensorflowonspark_tpu.utils import metrics as M
 
+    promoted = (_promoted_config().get("transformer", {})
+                if on_tpu else {})
     if on_tpu:
-        # largest config that fits one v5e with f32 adam state + the
-        # f32 logits/CE path at seq 2048 (dim 2048 needs ~19GB)
+        # base config fits one v5e with f32 adam state; the sweep's
+        # winner (scripts/sweep_transformer.py --promote) can raise
+        # batch / change flash blocks / enable remat via
+        # bench_config.json's "transformer" section
         cfg = transformer.Config(
             vocab_size=16384, dim=1024, n_layers=8, n_heads=8,
             max_seq=2048, dtype="bfloat16", attn_impl="flash",
         )
-        batch, steps = 8, 10
+        batch, steps = int(promoted.get("batch", 8)), 10
     else:
         cfg = transformer.Config(
             vocab_size=512, dim=128, n_layers=2, n_heads=4, max_seq=128,
             dtype="float32", attn_impl="reference",
         )
         batch, steps = 2, 3
+    remat = bool(promoted.get("remat", False))
+    attn_fn = None
+    if promoted.get("block_q") or promoted.get("block_kv"):
+        import functools
+
+        from tensorflowonspark_tpu import ops
+
+        attn_fn = functools.partial(
+            ops.flash_attention, causal=True,
+            block_q=int(promoted.get("block_q", 512)),
+            block_kv=int(promoted.get("block_kv", 512)))
 
     opt = optax.adam(1e-3)
 
@@ -443,7 +461,7 @@ def _transformer_bench(dev, on_tpu):
         def body(carry, _):
             p, o = carry
             loss, grads = jax.value_and_grad(transformer.loss_fn)(
-                p, tokens, cfg
+                p, tokens, cfg, attn_fn=attn_fn, remat=remat
             )
             updates, o = opt.update(grads, o)
             return (optax.apply_updates(p, updates), o), loss
@@ -454,12 +472,17 @@ def _transformer_bench(dev, on_tpu):
     dt, loss = _time_scanned(run, params, opt_state, tokens)
     toks_per_sec = batch * cfg.max_seq * steps / dt
     flops_per_tok = M.transformer_flops_per_token(cfg)
-    return {
+    out = {
         "tokens_per_sec_per_chip": round(toks_per_sec, 1),
         "mfu": round(toks_per_sec * flops_per_tok / _peak_flops(dev), 4),
         "dim": cfg.dim, "layers": cfg.n_layers, "seq": cfg.max_seq,
         "batch": batch, "loss": loss,
     }
+    if remat:
+        out["remat"] = True
+    if promoted:
+        out["promoted"] = {k: promoted[k] for k in sorted(promoted)}
+    return out
 
 
 def _tfrecord_bench(dev, on_tpu):

@@ -123,13 +123,22 @@ def main():
                   "bench to toy shapes", flush=True)
             return
         best_mfu, best = max(results)
-        cfg = dict(by_name[best], image=args.image, winner=best,
-                   mfu=round(best_mfu, 4), device=str(dev))
         path = os.path.join(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
             "bench_config.json")
+        cfg_all = {}
+        if os.path.exists(path):  # keep other sections (e.g. transformer)
+            try:
+                with open(path) as f:
+                    prior = json.load(f)
+                cfg_all = {k: v for k, v in prior.items()
+                           if isinstance(v, dict)}  # nested sections only
+            except (OSError, ValueError):
+                cfg_all = {}
+        cfg_all.update(by_name[best], image=args.image, winner=best,
+                       mfu=round(best_mfu, 4), device=str(dev))
         with open(path, "w") as f:
-            json.dump(cfg, f, indent=1)
+            json.dump(cfg_all, f, indent=1)
         print(f"promoted {best} (mfu {best_mfu:.4f}) -> {path}", flush=True)
 
 

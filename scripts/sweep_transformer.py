@@ -20,6 +20,9 @@ import numpy as np  # noqa: E402
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--promote", action="store_true",
+                    help="write the winner into bench_config.json's "
+                         '"transformer" section (picked up by bench.py)')
     args = ap.parse_args()
 
     import jax
@@ -78,6 +81,7 @@ def main():
 
     rng = np.random.default_rng(0)
     results = []
+    by_name = {}
     for name, batch, bq, bkv, remat in configs:
         try:
             tokens = jnp.asarray(
@@ -109,10 +113,35 @@ def main():
             print(f"{name:18s} tok/s={tps:9.0f}  mfu={mfu:.4f}  "
                   f"(compile {compile_s:.0f}s)", flush=True)
             results.append((mfu, name))
+            by_name[name] = {"batch": batch, "block_q": bq,
+                             "block_kv": bkv, "remat": remat}
         except Exception as e:  # noqa: BLE001 - keep sweeping
             print(f"{name:18s} FAILED: {str(e)[:160]}", flush=True)
     for mfu, name in sorted(results, reverse=True):
         print(f"  {mfu:.4f}  {name}")
+    if args.promote and results:
+        import json
+
+        if smoke or jax.devices()[0].platform == "cpu":
+            print("promote skipped: smoke/CPU runs must not pin the TPU "
+                  "bench to toy shapes", flush=True)
+            return
+        best_mfu, best = max(results)
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "bench_config.json")
+        cfg_all = {}
+        if os.path.exists(path):  # keep the resnet section
+            try:
+                with open(path) as f:
+                    cfg_all = json.load(f)
+            except (OSError, ValueError):
+                cfg_all = {}
+        cfg_all["transformer"] = dict(
+            by_name[best], winner=best, mfu=round(best_mfu, 4))
+        with open(path, "w") as f:
+            json.dump(cfg_all, f, indent=1)
+        print(f"promoted {best} (mfu {best_mfu:.4f}) -> {path}", flush=True)
 
 
 if __name__ == "__main__":

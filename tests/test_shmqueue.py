@@ -81,9 +81,9 @@ def test_cross_process_stream():
 
 
 def test_throughput_smoke():
-    """The ring should move >500 MB/s same-process (sanity, not a
-    bench).  Best-of-3: a single scheduler stall on a loaded box must
-    not flake a functional suite."""
+    """The ring should clear 100 MB/s same-process (sanity, not a
+    bench — real hardware does GB/s).  Best-of-3: a single scheduler
+    stall on a loaded box must not flake a functional suite."""
     q = shm.ShmQueue(f"/tfosq-test-{os.getpid()}-e", capacity=64 << 20, create=True)
     try:
         chunk = b"x" * (1 << 20)
