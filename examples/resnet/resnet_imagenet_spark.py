@@ -132,14 +132,45 @@ def _records(args, engine):
         from tensorflowonspark_tpu import dfutil
 
         ds, schema = dfutil.load_tfrecords(
-            engine, args.data_dir, binary_features=("image",)
+            engine, args.data_dir,
+            binary_features=("image", "image/encoded"),
         )
         image = args.image_size
 
         def to_row(rec):
-            raw = np.frombuffer(rec["image"], dtype=np.uint8)
-            return raw.reshape(image, image, 3), int(rec["label"])
+            # two layouts: raw uint8 under "image"/"label" (this repo's
+            # writers), or the TF-official ImageNet keys with JPEG bytes
+            # ("image/encoded", "image/class/label" — 1-based labels!)
+            data = rec.get("image", rec.get("image/encoded"))
+            if "label" in rec:
+                label = rec["label"]
+            else:
+                label = rec["image/class/label"]
+                label = (label[0] if isinstance(label, list) else label) - 1
+            if isinstance(label, list):
+                label = label[0]
+            raw = np.frombuffer(data, dtype=np.uint8)
+            if raw.size == image * image * 3:
+                return raw.reshape(image, image, 3), int(label)
+            import io
 
+            from PIL import Image  # host-side decode, one per record
+
+            img = Image.open(io.BytesIO(data)).convert("RGB")
+            if img.size != (image, image):
+                img = img.resize((image, image), Image.BILINEAR)
+            return np.asarray(img, np.uint8), int(label)
+
+        if ds.num_partitions < args.cluster_size:
+            # one partition feeds one worker; fewer shards than workers
+            # starves the rest and the synchronized stop ends training
+            # at step 0 — rebalance the ENCODED records (before decode,
+            # so the shuffle moves compact bytes, not decoded arrays;
+            # write >= cluster_size shards to avoid it entirely)
+            print(f"WARNING: {ds.num_partitions} data shard(s) for "
+                  f"{args.cluster_size} workers; repartitioning",
+                  flush=True)
+            ds = ds.repartition(args.cluster_size * 2)
         return ds.map_partitions(
             lambda it: [to_row(r) for r in it]
         )

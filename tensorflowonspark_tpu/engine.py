@@ -188,6 +188,20 @@ class LocalDataset:
             tasks.extend(o._tasks())
         return LocalDataset(self._engine, None, tasks=tasks)
 
+    def repartition(self, num_partitions):
+        """Rebalance into ``num_partitions`` round-robin partitions (RDD
+        ``repartition`` parity).  Needed when a feed source has fewer
+        partitions than executors — InputMode.SPARK feeds one partition
+        per feeder task, so a starved worker would trigger the
+        synchronized global-stop at step 0.  Local engine: materializes
+        through the driver (executor tasks still run the lineage);
+        production-scale data should be written with >= num_executors
+        shards instead."""
+        rows = self.collect()
+        n = max(1, min(num_partitions, max(len(rows), 1)))
+        parts = [rows[i::n] for i in range(n)]
+        return LocalDataset(self._engine, parts)
+
 
 # ----------------------------------------------------------------------------
 # Local engine
@@ -453,6 +467,9 @@ class SparkDataset:
         for o in others:
             rdd = rdd.union(o.rdd if isinstance(o, SparkDataset) else o)
         return SparkDataset(rdd)
+
+    def repartition(self, num_partitions):
+        return SparkDataset(self.rdd.repartition(num_partitions))
 
 
 class SparkEngine:

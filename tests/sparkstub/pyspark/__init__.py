@@ -158,6 +158,10 @@ class RDD:
             self._ds.union(*[o._ds for o in others]), self.context, self._barrier
         )
 
+    def repartition(self, num_partitions):
+        return RDD(self._ds.repartition(num_partitions), self.context,
+                   self._barrier)
+
     def barrier(self):
         return RDDBarrier(self)
 
