@@ -161,6 +161,31 @@ def _fed_run(fed, step_fn, params, state, opt_state):
 
     threading.Thread(target=watchdog, daemon=True).start()
 
+    # wedge watchdog: a feeder that stalls while alive (no records, no
+    # exit) must not turn the whole unattended bench into a hang.  The
+    # deadline is on PROGRESS, not wall clock — it resets every batch —
+    # and firing is loud: logged and flagged in the lane's result.
+    deadline_s = float(os.environ.get("TFOS_BENCH_FED_DEADLINE", "900"))
+    progress = {"n": -1, "deadline_hit": False}
+
+    def stall_watch():
+        import sys
+
+        last = (progress["n"], time.monotonic())
+        while not stop_watch.wait(min(15.0, deadline_s / 4 or 1)):
+            now = time.monotonic()
+            if progress["n"] != last[0]:
+                last = (progress["n"], now)
+            elif now - last[1] > deadline_s:
+                progress["deadline_hit"] = True
+                print(f"bench: fed lane made no progress for "
+                      f"{deadline_s:.0f}s; ending it early",
+                      file=sys.stderr, flush=True)
+                feed.poison()
+                return
+
+    threading.Thread(target=stall_watch, daemon=True).start()
+
     def collate(cols):
         return np.stack(cols["image"]), np.asarray(cols["label"], np.int32)
 
@@ -172,6 +197,7 @@ def _fed_run(fed, step_fn, params, state, opt_state):
     for imgs, labels in device_feed(feed, batch, collate=collate, depth=2):
         p, s, o, last, _ = fed_step(p, s, o, imgs, labels)
         nsteps += 1
+        progress["n"] = nsteps
         if nsteps == 1:
             last.block_until_ready()  # absorb any warmup/compile skew
             t0 = time.perf_counter()
@@ -195,7 +221,7 @@ def _fed_run(fed, step_fn, params, state, opt_state):
     fed["mgr"].set("state", "stopped")
     fed["ring"].close()
 
-    return {
+    out = {
         "images_per_sec_per_chip": round(fed_ips, 1),
         "loop_images_per_sec": round(loop_ips, 1),
         "vs_device_resident": round(fed_ips / loop_ips, 4) if loop_ips else None,
@@ -203,6 +229,9 @@ def _fed_run(fed, step_fn, params, state, opt_state):
         "infeed_stall_frac": round(stall / dt, 4) if dt else None,
         "steps": n_timed, "chunk_records": FED_CHUNK,
     }
+    if progress["deadline_hit"]:
+        out["deadline_hit"] = True  # truncated lane: numbers are partial
+    return out
 
 
 def _on_tpu_guess():

@@ -142,6 +142,10 @@ def _records(args, engine):
             # writers), or the TF-official ImageNet keys with JPEG bytes
             # ("image/encoded", "image/class/label" — 1-based labels!)
             data = rec.get("image", rec.get("image/encoded"))
+            if data is None:
+                raise ValueError(
+                    f"record has neither 'image' nor 'image/encoded' "
+                    f"features (got {sorted(rec)})")
             if "label" in rec:
                 label = rec["label"]
             else:
@@ -152,6 +156,11 @@ def _records(args, engine):
             raw = np.frombuffer(data, dtype=np.uint8)
             if raw.size == image * image * 3:
                 return raw.reshape(image, image, 3), int(label)
+            if not (data[:2] == b"\xff\xd8" or data[:4] == b"\x89PNG"):
+                raise ValueError(
+                    f"image payload is {raw.size} bytes: neither "
+                    f"{image}x{image}x3 raw uint8 nor JPEG/PNG — check "
+                    f"--image_size against the dataset")
             import io
 
             from PIL import Image  # host-side decode, one per record

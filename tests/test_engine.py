@@ -128,9 +128,12 @@ def test_repartition_balances_and_preserves_rows():
 
 
 def test_spark_dataset_repartition_via_stub():
+    import os
     import sys
 
-    sys.path.insert(0, "tests/sparkstub")
+    stub = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "sparkstub")
+    sys.path.insert(0, stub)
     try:
         import pyspark
 
@@ -146,4 +149,4 @@ def test_spark_dataset_repartition_via_stub():
         finally:
             sc.stop()
     finally:
-        sys.path.remove("tests/sparkstub")
+        sys.path.remove(stub)
