@@ -469,7 +469,8 @@ def _transformer_bench(dev, on_tpu):
         attn_fn = functools.partial(
             ops.flash_attention, causal=True,
             block_q=int(promoted.get("block_q", 512)),
-            block_kv=int(promoted.get("block_kv", 512)))
+            block_kv=int(promoted.get("block_kv", 512)),
+            bwd_impl=promoted.get("bwd", "xla"))
 
     opt = optax.adam(1e-3)
 

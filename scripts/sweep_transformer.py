@@ -59,36 +59,40 @@ def main():
     print("init done", flush=True)
 
     configs = [
-        # (name, batch, block_q, block_kv, remat)
-        ("b16_q512_kv512", 16, 512, 512, False),
-        ("b8_q512_kv512", 8, 512, 512, False),
-        ("b16_q1024_kv512", 16, 1024, 512, False),
-        ("b16_q512_kv1024", 16, 512, 1024, False),
-        ("b16_q1024_kv1024", 16, 1024, 1024, False),
-        ("b32_q512_kv512", 32, 512, 512, False),
-        ("b32_q512_kv512_remat", 32, 512, 512, True),
-        ("b64_q512_kv512_remat", 64, 512, 512, True),
+        # (name, batch, block_q, block_kv, remat, bwd)
+        ("b16_q512_kv512", 16, 512, 512, False, "xla"),
+        ("b16_q512_kv512_pbwd", 16, 512, 512, False, "pallas"),
+        ("b8_q512_kv512", 8, 512, 512, False, "xla"),
+        ("b16_q1024_kv512", 16, 1024, 512, False, "xla"),
+        ("b16_q512_kv1024", 16, 512, 1024, False, "xla"),
+        ("b16_q1024_kv1024", 16, 1024, 1024, False, "xla"),
+        ("b32_q512_kv512", 32, 512, 512, False, "xla"),
+        ("b32_q512_kv512_remat", 32, 512, 512, True, "xla"),
+        ("b32_q512_kv512_remat_pbwd", 32, 512, 512, True, "pallas"),
+        ("b64_q512_kv512_remat", 64, 512, 512, True, "xla"),
     ]
     subset = os.environ.get("TFOS_SWEEP")
     if subset:
         want = set(subset.split(","))
         configs = [c for c in configs if c[0] in want]
-    if smoke:  # plumbing check (CPU): tiny batch, blocks fitting max_seq,
-        # always including one remat config so that plumbing is exercised
-        picked = configs[:2] + [c for c in configs[2:] if c[4]][:1]
-        configs = [(n, 1, min(bq, 128), min(bkv, 128), r)
-                   for n, _, bq, bkv, r in picked]
+    if smoke:  # plumbing check (CPU): tiny batch, blocks fitting
+        # max_seq, always including one remat and one pallas-bwd config
+        picked = (configs[:2] + [c for c in configs[2:] if c[4]][:1]
+                  + [c for c in configs[2:] if c[5] == "pallas"][:1])
+        configs = [(n, 1, min(bq, 128), min(bkv, 128), r, bw)
+                   for n, _, bq, bkv, r, bw in picked]
 
     rng = np.random.default_rng(0)
     results = []
     by_name = {}
-    for name, batch, bq, bkv, remat in configs:
+    for name, batch, bq, bkv, remat, bwd in configs:
         try:
             tokens = jnp.asarray(
                 rng.integers(0, cfg.vocab_size, (batch, cfg.max_seq)),
                 jnp.int32)
             attn = functools.partial(
-                ops.flash_attention, causal=True, block_q=bq, block_kv=bkv)
+                ops.flash_attention, causal=True, block_q=bq, block_kv=bkv,
+                bwd_impl=bwd)
 
             @jax.jit
             def run(params, opt_state, tokens):
@@ -114,7 +118,7 @@ def main():
                   f"(compile {compile_s:.0f}s)", flush=True)
             results.append((mfu, name))
             by_name[name] = {"batch": batch, "block_q": bq,
-                             "block_kv": bkv, "remat": remat}
+                             "block_kv": bkv, "remat": remat, "bwd": bwd}
         except Exception as e:  # noqa: BLE001 - keep sweeping
             print(f"{name:18s} FAILED: {str(e)[:160]}", flush=True)
     for mfu, name in sorted(results, reverse=True):

@@ -192,8 +192,183 @@ def _flash_forward(q, k, v, *, causal, scale, block_q, block_kv, interpret):
     return out, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_kv, interpret):
+def _bwd_recompute(q_blk, k_blk, v_blk, g_blk, lse, delta, q_start,
+                   kv_start, *, seq_q, seq_kv, scale, causal):
+    """Shared backward recompute for one (q block, kv block) pair:
+    probabilities from (q, k, lse) and the score gradient
+        p  = exp(q kᵀ·scale − lse)        (masked)
+        ds = p · (g vᵀ − delta) · scale
+    Both kernels MUST use this — a masking/math fix applied to one of
+    dq vs dk/dv only would silently desynchronize the gradients."""
+    block_q, block_kv = q_blk.shape[0], k_blk.shape[0]
+    s = jnp.dot(q_blk, k_blk.T, preferred_element_type=jnp.float32) * scale
+    qpos = q_start + lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
+    kpos = kv_start + lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
+    valid = (kpos < seq_kv) & (qpos < seq_q)
+    if causal:
+        valid &= qpos >= kpos
+    p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)
+    dp = jnp.dot(g_blk, v_blk.T, preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[:, None]) * scale
+    return p, ds
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref, *,
+               seq_q, seq_kv, block_q, block_kv, scale, causal):
+    """dq for one [block_q, D] q tile: loop kv blocks (causal-trimmed,
+    like the forward), recomputing p from (q, k, lse):
+        p = exp(q kᵀ·scale − lse);  ds = p·(g vᵀ − delta)·scale;
+        dq = ds k
+    """
+    import jax.experimental.pallas as pl
+
+    q_blk = q_ref[0].astype(jnp.float32)          # [bq, D]
+    g_blk = g_ref[0].astype(jnp.float32)          # [bq, D]
+    lse = lse_ref[0, :, 0]                        # [bq]
+    delta = delta_ref[0, :, 0]                    # [bq]
+    head_dim = q_blk.shape[-1]
+    q_start = pl.program_id(1) * block_q
+
+    num_kv = pl.cdiv(seq_kv, block_kv)
+    if causal:
+        num_kv = lax.min(
+            num_kv, lax.div(q_start + block_q + block_kv - 1, block_kv)
+        )
+
+    def body(j, dq):
+        kv_start = j * block_kv
+        k_blk = k_ref[0, pl.ds(kv_start, block_kv), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kv_start, block_kv), :].astype(jnp.float32)
+        _, ds = _bwd_recompute(
+            q_blk, k_blk, v_blk, g_blk, lse, delta, q_start, kv_start,
+            seq_q=seq_q, seq_kv=seq_kv, scale=scale, causal=causal)
+        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+
+    dq = lax.fori_loop(
+        0, num_kv, body, jnp.zeros((block_q, head_dim), jnp.float32)
+    )
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dk_ref,
+                dv_ref, *, seq_q, seq_kv, block_q, block_kv, scale, causal):
+    """dk/dv for one [block_kv, D] kv tile: loop q blocks starting at the
+    diagonal (causal lower bound — above-diagonal q blocks see none of
+    this kv tile):
+        dv += pᵀ g;  dk += dsᵀ q
+    """
+    import jax.experimental.pallas as pl
+
+    k_blk = k_ref[0].astype(jnp.float32)          # [bkv, D]
+    v_blk = v_ref[0].astype(jnp.float32)          # [bkv, D]
+    head_dim = k_blk.shape[-1]
+    kv_start = pl.program_id(1) * block_kv
+
+    num_q = pl.cdiv(seq_q, block_q)
+    i0 = lax.div(kv_start, block_q) if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q_start = i * block_q
+        q_blk = q_ref[0, pl.ds(q_start, block_q), :].astype(jnp.float32)
+        g_blk = g_ref[0, pl.ds(q_start, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(q_start, block_q), 0]
+        delta = delta_ref[0, pl.ds(q_start, block_q), 0]
+        p, ds = _bwd_recompute(
+            q_blk, k_blk, v_blk, g_blk, lse, delta, q_start, kv_start,
+            seq_q=seq_q, seq_kv=seq_kv, scale=scale, causal=causal)
+        dv = dv + jnp.dot(p.T, g_blk, preferred_element_type=jnp.float32)
+        dk = dk + jnp.dot(ds.T, q_blk, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    zeros = jnp.zeros((block_kv, head_dim), jnp.float32)
+    dk, dv = lax.fori_loop(i0, num_q, body, (zeros, zeros))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward_pallas(q, k, v, out, lse, g, *, causal, scale, block_q,
+                           block_kv, interpret):
+    """Blockwise pallas backward: dq over q tiles (kv loop trimmed above
+    the diagonal) + dk/dv over kv tiles (q loop started at the diagonal)
+    — the causal triangle is never computed, unlike the XLA fallback
+    which computes and masks it (~2x the attention-backward FLOPs at
+    long seq)."""
+    import jax.experimental.pallas as pl
+
+    b, sq, h, d = q.shape
+    skv = k.shape[1]
+    block_q = min(block_q, max(sq, 8))
+    block_kv = min(block_kv, max(skv, 8))
+
+    def flat(x):  # [B, S, H, D] -> [B*H, S, D]
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    qf, kf, vf, gf, of = flat(q), flat(k), flat(v), flat(g), flat(out)
+    delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [B*H, Sq, 1]
+    lsef = lse.reshape(b * h, sq, 1)
+
+    pad_q = (-sq) % block_q
+    pad_kv = (-skv) % block_kv
+    if pad_q:
+        zq = ((0, 0), (0, pad_q), (0, 0))
+        qf, gf = jnp.pad(qf, zq), jnp.pad(gf, zq)
+        lsef, delta = jnp.pad(lsef, zq), jnp.pad(delta, zq)
+    if pad_kv:
+        zkv = ((0, 0), (0, pad_kv), (0, 0))
+        kf, vf = jnp.pad(kf, zkv), jnp.pad(vf, zkv)
+
+    sq_p, skv_p = sq + pad_q, skv + pad_kv
+    common = dict(seq_q=sq, seq_kv=skv, block_q=block_q,
+                  block_kv=block_kv, scale=scale, causal=causal)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, **common),
+        grid=(b * h, sq_p // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, skv_p, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, skv_p, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, i: (bh, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf, gf, lsef, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, **common),
+        grid=(b * h, skv_p // block_kv),
+        in_specs=[
+            pl.BlockSpec((1, sq_p, d), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, sq_p, d), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, sq_p, 1), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, sq_p, 1), lambda bh, j: (bh, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_kv, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda bh, j: (bh, j, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * h, skv_p, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, skv_p, d), v.dtype),
+        ),
+        interpret=interpret,
+    )(qf, kf, vf, gf, lsef, delta)
+
+    def unflat(x, s):  # [B*H, S, D] -> [B, S, H, D]
+        return x[:, :s].reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+    return unflat(dq, sq), unflat(dk, skv), unflat(dv, skv)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, scale, block_q, block_kv, interpret, bwd_impl):
     out, _lse = _flash_forward(
         q, k, v, causal=causal, scale=scale, block_q=block_q,
         block_kv=block_kv, interpret=interpret,
@@ -201,7 +376,8 @@ def _flash(q, k, v, causal, scale, block_q, block_kv, interpret):
     return out
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret,
+               bwd_impl):
     out, lse = _flash_forward(
         q, k, v, causal=causal, scale=scale, block_q=block_q,
         block_kv=block_kv, interpret=interpret,
@@ -209,7 +385,17 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_kv, interpret, res, g):
+def _flash_bwd(causal, scale, block_q, block_kv, interpret, bwd_impl, res, g):
+    if bwd_impl == "pallas":
+        q, k, v, out, lse = res
+        return _flash_backward_pallas(
+            q, k, v, out, lse, g, causal=causal, scale=scale,
+            block_q=block_q, block_kv=block_kv, interpret=interpret,
+        )
+    return _flash_bwd_xla(causal, scale, block_q, block_kv, res, g)
+
+
+def _flash_bwd_xla(causal, scale, block_q, block_kv, res, g):
     """Blockwise flash backward (pure XLA, lax.scan over q blocks).
 
     Memory is O(block_q * S_kv) per step instead of the O(S^2) score
@@ -279,11 +465,16 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, *, causal=False, scale=None, block_q=512,
-                    block_kv=512, interpret=None):
+                    block_kv=512, interpret=None, bwd_impl="xla"):
     """Flash attention on [B, S, H, D]; differentiable.
 
     ``interpret=None`` auto-selects: compiled pallas on TPU, interpreter
     mode elsewhere (CPU tests / virtual-device meshes).
+
+    ``bwd_impl``: "xla" (default — blockwise scan, computes-then-masks
+    the causal triangle) or "pallas" (dq/dkv kernels whose block loops
+    are trimmed at the diagonal, skipping ~half the causal backward
+    FLOPs at long seq; numerics identical, see tests).
 
     Defaults tuned on v5e (B=4, S=2048, H=8, D=128: 512/512 is ~4x the
     128/128 throughput).  The kernel keeps the full k/v sequence of one
@@ -295,4 +486,8 @@ def flash_attention(q, k, v, *, causal=False, scale=None, block_q=512,
         scale = 1.0 / math.sqrt(q.shape[-1])
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash(q, k, v, causal, scale, block_q, block_kv, interpret)
+    if bwd_impl not in ("xla", "pallas"):
+        raise ValueError(f"bwd_impl must be 'xla' or 'pallas', "
+                         f"got {bwd_impl!r}")
+    return _flash(q, k, v, causal, scale, block_q, block_kv, interpret,
+                  bwd_impl)
