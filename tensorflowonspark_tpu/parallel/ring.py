@@ -94,18 +94,31 @@ def ring_attention(q, k, v, axis_name, *, causal=False, scale=None):
         # causal masking m is finite after step 0 for every valid row
         # and fully-masked later blocks contribute exp(-inf - m) = 0.
         kv_idx = (my_idx - step) % axis_size
-        s = _block_scores(
-            q, k_cur, scale, causal,
-            q_offset=my_idx * s_local, kv_offset=kv_idx * s_local,
-        )
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[..., None])
-        l = l * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32)
-        )
-        m = m_new
+
+        def do_block(carry, k_blk=k_cur, v_blk=v_cur, kv_i=kv_idx):
+            acc, m, l = carry
+            s = _block_scores(
+                q, k_blk, scale, causal,
+                q_offset=my_idx * s_local, kv_offset=kv_i * s_local,
+            )
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new[..., None])
+            l_new = l * alpha + jnp.sum(p, axis=-1)
+            acc_new = acc * alpha[..., None] + jnp.einsum(
+                "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
+            )
+            return acc_new, m_new, l_new
+
+        if causal:
+            # a kv shard strictly after the q shard is fully masked —
+            # skip its score/softmax compute entirely (the ring still
+            # rotates it, but ~half the blocks cost nothing)
+            acc, m, l = lax.cond(
+                kv_idx > my_idx, lambda c: c, do_block, (acc, m, l)
+            )
+        else:
+            acc, m, l = do_block((acc, m, l))
         if step + 1 < axis_size:
             k_cur = lax.ppermute(k_cur, axis_name, perm)
             v_cur = lax.ppermute(v_cur, axis_name, perm)
