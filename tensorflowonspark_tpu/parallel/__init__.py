@@ -15,9 +15,12 @@ from tensorflowonspark_tpu.parallel.mesh import (  # noqa: F401
     sharded,
 )
 from tensorflowonspark_tpu.parallel.ring import (  # noqa: F401
+    inverse_permutation,
     ring_attention,
     sequence_parallel_attention,
     ulysses_attention,
+    zigzag_permutation,
+    zigzag_ring_attention,
 )
 from tensorflowonspark_tpu.parallel.sharding import (  # noqa: F401
     apply_shardings,
