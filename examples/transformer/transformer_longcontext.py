@@ -27,7 +27,8 @@ def main():
     p.add_argument("--n_heads", type=int, default=4)
     p.add_argument("--vocab", type=int, default=256)
     p.add_argument("--lr", type=float, default=1e-3)
-    p.add_argument("--attn", choices=["ring", "ulysses"], default="ring")
+    p.add_argument("--attn", choices=["ring", "zigzag", "ulysses"],
+                   default="ring")
     args = p.parse_args()
 
     import jax
@@ -48,6 +49,14 @@ def main():
     n = len(devs)
     seq = max(n // 4, 1) * 2 if n >= 8 else max(n // 2, 1)
     model = 2 if n % 2 == 0 and n >= 4 else 1
+    if args.attn == "ulysses":
+        # ulysses re-shards seq->heads: the per-model-shard head count
+        # must divide by the seq axis; the shrunk seq must also keep
+        # dividing the device count (seq*model | n) or the mesh reshape
+        # would fail
+        while seq > 1 and ((args.n_heads // model) % seq
+                           or n % (seq * model)):
+            seq //= 2
     data = n // (seq * model)
     mesh = Mesh(np.array(devs).reshape(data, seq, model),
                 ("data", "seq", "model"))
@@ -66,7 +75,25 @@ def main():
     params = jax.device_put(params, specs)
     opt = optax.adam(args.lr)
     opt_state = opt.init(params)
-    attn_fn = sequence_parallel_attention(mesh, args.attn, causal=True)
+    if args.attn == "zigzag":
+        # load-balanced causal ring: attention runs over the
+        # zigzag-reordered sequence; permuting q/k/v around the call
+        # keeps the rest of the model (rope, LM loss shift) in original
+        # order.  Production long-context runs permute the TOKENS once
+        # and keep positions explicit instead of paying the per-layer
+        # gather — this demo shows the attention-level API.
+        from tensorflowonspark_tpu.parallel import (
+            inverse_permutation, zigzag_permutation,
+        )
+
+        zz = sequence_parallel_attention(mesh, "zigzag", causal=True)
+        perm = zigzag_permutation(args.seq_len, mesh.shape["seq"])
+        inv = inverse_permutation(perm)
+
+        def attn_fn(q, k, v):
+            return zz(q[:, perm], k[:, perm], v[:, perm])[:, inv]
+    else:
+        attn_fn = sequence_parallel_attention(mesh, args.attn, causal=True)
 
     @jax.jit
     def step(params, opt_state, tokens):
