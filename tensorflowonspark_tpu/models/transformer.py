@@ -125,13 +125,13 @@ def _matmul(x, w):
 def _layer_apply(p, x, cfg, rope, attn_fn):
     b, s, dim = x.shape
     h, hd = cfg.n_heads, cfg.head_dim
-    cos, sin = rope
+    cos, sin, positions = rope
 
     y = ops.rmsnorm_reference(x, p["ln1"])
     qkv = _matmul(y, p["wqkv"]).reshape(b, s, 3, h, hd)
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-    q = ops.apply_rope(q, cos, sin)
-    k = ops.apply_rope(k, cos, sin)
+    q = ops.apply_rope(q, cos, sin, positions=positions)
+    k = ops.apply_rope(k, cos, sin, positions=positions)
     attn = attn_fn(q, k, v).reshape(b, s, dim)
     x = x + _matmul(attn, p["wo"])
 
@@ -141,7 +141,7 @@ def _layer_apply(p, x, cfg, rope, attn_fn):
 
 
 def apply(params, tokens, cfg: Config, *, attn_fn=None,
-          logits_dtype=jnp.float32, remat=False):
+          logits_dtype=jnp.float32, remat=False, positions=None):
     """tokens [B, S] int32 -> logits [B, S, vocab] (``logits_dtype``,
     default float32; pass None to keep the compute dtype — the training
     loss does, so the [B,S,vocab] activation stays bfloat16 in HBM).
@@ -151,18 +151,44 @@ def apply(params, tokens, cfg: Config, *, attn_fn=None,
     ``parallel.sequence_parallel_attention(mesh, 'ring', causal=True)``
     for sequence-parallel long-context runs.
 
+    ``positions`` ([S] or [B, S] int32): explicit global rope positions
+    for sequences not in contiguous order — e.g. zigzag-permuted
+    long-context batches (``parallel.zigzag_permutation``).  The default
+    causal flash mask assumes CONTIGUOUS order; with permuted input,
+    pass an ``attn_fn`` whose masking understands the layout
+    (``sequence_parallel_attention(mesh, 'zigzag', causal=True)``).
+
     ``remat=True`` checkpoints each scanned layer: the backward pass
     recomputes layer internals instead of keeping ~10·dim·B·S bytes per
     layer resident, trading ~30% more FLOPs for an O(L·B·S·dim) →
     O(B·S·dim) activation footprint (how the bigger sweep batches fit).
     """
+    if positions is not None and attn_fn is None:
+        # the default flash mask is causal by ARRAY INDEX; on permuted
+        # input that silently attends to the future — demand an attn_fn
+        # whose masking understands the layout
+        raise ValueError(
+            "positions= implies a non-contiguous sequence layout; pass an "
+            "attn_fn that masks by global position (e.g. "
+            "sequence_parallel_attention(mesh, 'zigzag', causal=True))")
     if attn_fn is None:
         base = (ops.flash_attention if cfg.attn_impl == "flash"
                 else ops.mha_reference)
         attn_fn = functools.partial(base, causal=True)
     dtype = cfg.compute_dtype
     x = params["embed"].astype(dtype)[tokens]
-    rope = ops.rope_angles(tokens.shape[1], cfg.head_dim, cfg.rope_base)
+    if positions is None:
+        rope_len = tokens.shape[1]
+        pos2d = None
+    else:
+        # cover every global position: jax gather would silently CLAMP
+        # an index past the table instead of erroring
+        rope_len = max(tokens.shape[1], cfg.max_seq)
+        pos = jnp.asarray(positions, jnp.int32)
+        pos2d = jnp.broadcast_to(
+            pos[None] if pos.ndim == 1 else pos, tokens.shape)
+    cos, sin = ops.rope_angles(rope_len, cfg.head_dim, cfg.rope_base)
+    rope = (cos, sin, pos2d)
 
     layer_fn = _layer_apply
     if remat:
@@ -178,8 +204,15 @@ def apply(params, tokens, cfg: Config, *, attn_fn=None,
     return logits if logits_dtype is None else logits.astype(logits_dtype)
 
 
-def loss_fn(params, tokens, cfg: Config, *, attn_fn=None, remat=False):
+def loss_fn(params, tokens, cfg: Config, *, attn_fn=None, remat=False,
+            labels=None, positions=None):
     """Next-token cross entropy (mean over B, S-1).
+
+    Default: labels are ``tokens`` shifted by one (contiguous order).
+    For permuted layouts (zigzag long-context), pass explicit ``labels``
+    aligned with ``tokens``' positions (-1 = ignore, e.g. each row's
+    final global position) plus matching ``positions`` — see
+    ``zigzag_lm_batch``.
 
     Logits stay in the compute dtype (bfloat16); the softmax/CE
     reductions accumulate in float32 — XLA fuses the upcast into the
@@ -187,11 +220,34 @@ def loss_fn(params, tokens, cfg: Config, *, attn_fn=None, remat=False):
     finding: the f32 logits path cost ~2 GB of HBM traffic per step at
     dim 1024 / seq 2048 / vocab 16k)."""
     logits = apply(params, tokens, cfg, attn_fn=attn_fn, logits_dtype=None,
-                   remat=remat)
-    logits = logits[:, :-1]
-    labels = tokens[:, 1:]
+                   remat=remat, positions=positions)
+    if labels is None:
+        logits = logits[:, :-1]
+        labels = tokens[:, 1:]
+        valid = None
+    else:
+        valid = labels >= 0
+        labels = jnp.maximum(labels, 0)
     lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
     gold = jnp.take_along_axis(
         logits, labels[..., None].astype(jnp.int32), axis=-1
     )[..., 0].astype(jnp.float32)
-    return jnp.mean(lse - gold)
+    nll = lse - gold
+    if valid is None:
+        return jnp.mean(nll)
+    vf = valid.astype(jnp.float32)
+    return jnp.sum(nll * vf) / jnp.maximum(jnp.sum(vf), 1.0)
+
+
+def zigzag_lm_batch(tokens, perm):
+    """Prepare a contiguous-order LM batch for zigzag training:
+    returns ``(tokens_p, labels_p, positions)`` where ``tokens_p`` is
+    the zigzag-permuted sequence, ``labels_p`` the next token of each
+    position in ORIGINAL order (-1 at the final global position), and
+    ``positions`` the global rope positions — feed to ``loss_fn(...,
+    labels=labels_p, positions=positions)`` with a zigzag ``attn_fn``.
+    """
+    labels = jnp.concatenate(
+        [tokens[:, 1:], jnp.full((tokens.shape[0], 1), -1, tokens.dtype)],
+        axis=1)
+    return tokens[:, perm], labels[:, perm], jnp.asarray(perm, jnp.int32)
