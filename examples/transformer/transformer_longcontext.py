@@ -76,29 +76,27 @@ def main():
     opt = optax.adam(args.lr)
     opt_state = opt.init(params)
     if args.attn == "zigzag":
-        # load-balanced causal ring: attention runs over the
-        # zigzag-reordered sequence; permuting q/k/v around the call
-        # keeps the rest of the model (rope, LM loss shift) in original
-        # order.  Production long-context runs permute the TOKENS once
-        # and keep positions explicit instead of paying the per-layer
-        # gather — this demo shows the attention-level API.
-        from tensorflowonspark_tpu.parallel import (
-            inverse_permutation, zigzag_permutation,
-        )
+        # production zigzag: tokens are permuted ONCE per batch
+        # (zigzag_lm_batch), rope positions and next-token labels are
+        # explicit, and the loss runs directly on the permuted layout —
+        # no per-layer gathers; the causal ring's critical path halves
+        from tensorflowonspark_tpu.parallel import zigzag_permutation
 
-        zz = sequence_parallel_attention(mesh, "zigzag", causal=True)
-        perm = zigzag_permutation(args.seq_len, mesh.shape["seq"])
-        inv = inverse_permutation(perm)
-
-        def attn_fn(q, k, v):
-            return zz(q[:, perm], k[:, perm], v[:, perm])[:, inv]
+        attn_fn = sequence_parallel_attention(mesh, "zigzag", causal=True)
+        zz_perm = zigzag_permutation(args.seq_len, mesh.shape["seq"])
     else:
         attn_fn = sequence_parallel_attention(mesh, args.attn, causal=True)
+        zz_perm = None
 
     @jax.jit
     def step(params, opt_state, tokens):
+        toks, labels, positions = (
+            transformer.zigzag_lm_batch(tokens, zz_perm)
+            if zz_perm is not None else (tokens, None, None)
+        )
         loss, grads = jax.value_and_grad(transformer.loss_fn)(
-            params, tokens, cfg, attn_fn=attn_fn
+            params, toks, cfg, attn_fn=attn_fn, labels=labels,
+            positions=positions,
         )
         updates, opt_state = opt.update(grads, opt_state)
         return optax.apply_updates(params, updates), opt_state, loss
