@@ -112,9 +112,18 @@ class DataFeed:
         # producer closures so both sides always agree on the transport
         self._ring = open_feed_ring(mgr, qname_in, producer=False)
 
-    def _get_once(self, timeout_ms):
-        """One bounded pop attempt; raises TimeoutError when empty."""
+    def _get_once(self, timeout_ms, honor_stop=False):
+        """One bounded pop attempt; raises TimeoutError when empty.
+
+        ``honor_stop`` (the consumer path): re-check the stop flag AFTER
+        acquiring the lock — a consumer that queued on the lock behind
+        terminate()'s drain (which holds it in up-to-1s slices) would
+        otherwise act on a stop check from before the drain began and
+        pop a chunk the drain was supposed to absorb.  terminate()
+        itself pops with the flag set, so its calls leave this off."""
         with self._lock:
+            if honor_stop and self._stop_requested:
+                raise TimeoutError("feed terminating")
             if self._ring is not None:
                 return self._ring.get(timeout_ms)
             if self._queue is None:  # resolve the manager proxy once
@@ -140,7 +149,7 @@ class DataFeed:
                 chunk = None  # terminate(): consume no further data
                 break
             try:
-                chunk = self._get_once(timeout_ms=slice_ms)
+                chunk = self._get_once(timeout_ms=slice_ms, honor_stop=True)
                 break
             except TimeoutError:
                 continue
