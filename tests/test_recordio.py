@@ -279,3 +279,54 @@ def test_dfutil_columnar_file_list_and_empty_shards(tmp_path):
     e.mkdir()
     (e / "part-r-00000").write_bytes(b"")
     assert dfutil.load_tfrecords_columnar(str(e)) == {}
+
+
+def test_decoder_fuzz_no_crash():
+    """The hand-rolled proto wire parser consumes untrusted bytes; seeded
+    mutations (flips/truncations/insertions) must raise or fail cleanly,
+    never corrupt memory.  (A longer 6000-case run was clean; this keeps
+    a fast seeded regression in the suite.)"""
+    import ctypes
+
+    rng = np.random.default_rng(7)
+    base = recordio.encode_example({
+        "vec": ("float", [1.0, 2.0, 3.0]),
+        "n": ("int64", [7, 8]),
+        "s": ("bytes", [b"abc"]),
+    })
+    for _ in range(300):
+        buf = bytearray(base)
+        for _ in range(rng.integers(1, 6)):
+            op = rng.integers(0, 3)
+            if op == 0 and len(buf) > 1:
+                buf[rng.integers(0, len(buf))] ^= rng.integers(1, 256)
+            elif op == 1 and len(buf) > 2:
+                del buf[rng.integers(1, len(buf)):]
+            else:
+                pos = rng.integers(0, len(buf) + 1)
+                buf[pos:pos] = bytes(rng.integers(0, 256, rng.integers(1, 5)))
+        try:
+            recordio.decode_example(bytes(buf))
+        except (ValueError, OverflowError):
+            pass
+
+    lib = native.load()
+    if lib is None or not getattr(lib, "_tfos_mem_api", False):
+        return
+    w = lib.tfr_mem_writer_new()
+    lib.tfr_mem_writer_write(w, base, len(base))
+    n = ctypes.c_uint64()
+    p = lib.tfr_mem_writer_data(w, ctypes.byref(n))
+    framed = ctypes.string_at(p, n.value)
+    lib.tfr_mem_writer_free(w)
+    for _ in range(300):
+        buf = bytearray(framed)
+        for _ in range(rng.integers(1, 4)):
+            if rng.integers(0, 2) and len(buf) > 1:
+                buf[rng.integers(0, len(buf))] ^= rng.integers(1, 256)
+            elif len(buf) > 2:
+                del buf[rng.integers(1, len(buf)):]
+        data = bytes(buf)
+        h = lib.tfr_load_columnar_mem(data, len(data))
+        if h:
+            lib.colb_free(h)
