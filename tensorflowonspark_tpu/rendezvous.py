@@ -85,11 +85,22 @@ class Reservations:
 class MessageSocket:
     """Length-prefixed JSON datagrams over a stream socket."""
 
+    # a corrupt or hostile length prefix must not make either end buffer
+    # up to 4GB from one connection.  64MB leaves orders of magnitude of
+    # headroom over the largest legitimate frame (the QINFO reservations
+    # list: ~100 bytes/node, so ~640k nodes) while bounding the damage.
+    MAX_FRAME = 64 << 20
+
     def receive(self, sock):
         header = self._recv_exact(sock, _HEADER.size)
         if header is None:
             return None
         (length,) = _HEADER.unpack(header)
+        if length > self.MAX_FRAME:
+            logger.warning(
+                "dropping connection: frame length %d exceeds %d "
+                "(corrupt or hostile peer)", length, self.MAX_FRAME)
+            return None
         payload = self._recv_exact(sock, length)
         if payload is None:
             return None

@@ -126,3 +126,30 @@ class TestEnvOverrides:
             with pytest.raises(OSError):
                 s2.start()
             s1.stop()
+
+
+def test_oversized_frame_is_rejected_and_server_survives():
+    """A corrupt/hostile length prefix must not buffer gigabytes on the
+    driver: the connection is dropped and legitimate clients still
+    register afterwards."""
+    import socket
+    import struct
+
+    from tensorflowonspark_tpu import rendezvous
+
+    server = rendezvous.Server(1)
+    addr = server.start()
+    try:
+        s = socket.create_connection(addr, timeout=5)
+        s.sendall(struct.pack(">I", 0xFFFFFFF0))  # claim a ~4GB frame
+        s.sendall(b"junk")
+        s.close()
+
+        client = rendezvous.Client(addr)
+        client.register({"executor_id": 0, "host": "h", "job_name": "worker",
+                         "task_index": 0, "port": 1})
+        info = client.await_reservations()
+        assert len(info) == 1
+        client.close()
+    finally:
+        server.stop()
