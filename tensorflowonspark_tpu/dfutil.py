@@ -203,20 +203,12 @@ def load_tfrecords_columnar(source):
     files = [f for f, _ in pairs]
     shards = [s for _, s in pairs]
 
-    def signature(shard):
-        # name -> (kind, dtype, trailing shape) — dtype/width drift across
-        # shards must error, not silently upcast under np.concatenate
-        return {
-            name: (kind, col.dtype.name, col.shape[1:])
-            if isinstance(col, np.ndarray) else (kind, "list", None)
-            for name, (kind, col) in shard.items()
-        }
-
-    sig = signature(shards[0])
+    sig = _columnar_signature(shards[0])
     for f, s in zip(files[1:], shards[1:]):
-        if signature(s) != sig:
+        if _columnar_signature(s) != sig:
             raise ValueError(
-                f"shard {f} schema {signature(s)} != first shard's {sig}")
+                f"shard {f} schema {_columnar_signature(s)} != "
+                f"first shard's {sig}")
     out = {}
     for name, (kind, col) in shards[0].items():
         parts = [col] + [s[name][1] for s in shards[1:]]
@@ -228,6 +220,76 @@ def load_tfrecords_columnar(source):
                 merged.extend(p)
             out[name] = merged
     return out
+
+
+def _columnar_signature(shard):
+    """name -> (kind, dtype, trailing shape): dtype/width drift across
+    shards must error, not silently upcast under np.concatenate."""
+    import numpy as np
+
+    return {
+        name: (kind, col.dtype.name, col.shape[1:])
+        if isinstance(col, np.ndarray) else (kind, "list", None)
+        for name, (kind, col) in shard.items()
+    }
+
+
+def iter_tfrecords_columnar(source, batch_size, *, drop_remainder=False):
+    """Stream dense column batches from TFRecords one shard at a time:
+    yields {name: ndarray [b]/[b,w] or list-of-bytes} without ever
+    holding more than one shard (plus a batch remainder) in memory —
+    the larger-than-RAM companion to ``load_tfrecords_columnar``.
+
+    ``source``: dir, single file, or explicit shard list.  Batches are
+    exactly ``batch_size`` rows except a final short batch (dropped with
+    ``drop_remainder=True`` — SPMD steps want full shapes).  Cross-shard
+    dtype/width drift raises, empty shards are skipped, and row order is
+    shard order (matching the bulk loader).
+    """
+    import numpy as np
+
+    if batch_size < 1:
+        raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+    files = source if isinstance(source, (list, tuple)) \
+        else _part_files(source)
+
+    def concat(parts):
+        if isinstance(parts[0], np.ndarray):
+            return parts[0] if len(parts) == 1 else np.concatenate(parts)
+        out = []
+        for p in parts:
+            out.extend(p)
+        return out
+
+    sig = None
+    rest = None  # {name: bare partial column} carried across shards
+    for f in files:
+        shard = recordio.load_columnar(f)
+        if not shard:
+            continue
+        shard_sig = _columnar_signature(shard)
+        if sig is None:
+            sig = shard_sig
+        elif shard_sig != sig:
+            raise ValueError(
+                f"shard {f} schema {shard_sig} != first shard's {sig}")
+        cols = {name: col for name, (_k, col) in shard.items()}
+        if rest:
+            cols = {name: concat([rest[name], cols[name]]) for name in cols}
+        n = len(next(iter(cols.values())))
+        lo = 0
+        while n - lo >= batch_size:
+            yield {name: col[lo:lo + batch_size]
+                   for name, col in cols.items()}
+            lo += batch_size
+        # copy ndarray remainders: a slice VIEW would pin the whole
+        # shard-sized base array until the next shard's concat
+        rest = ({name: (col[lo:].copy() if isinstance(col, np.ndarray)
+                        else col[lo:])
+                 for name, col in cols.items()}
+                if lo < n else None)
+    if rest and not drop_remainder:
+        yield rest
 
 
 def is_loaded_df(path):

@@ -330,3 +330,34 @@ def test_decoder_fuzz_no_crash():
         h = lib.tfr_load_columnar_mem(data, len(data))
         if h:
             lib.colb_free(h)
+
+
+def test_iter_columnar_streams_batches(tmp_path):
+    d = tmp_path / "tfr"
+    d.mkdir()
+    # three shards with awkward sizes so batches cross shard boundaries
+    _write_examples(d / "part-r-00000",
+                    [{"x": ("int64", [i]), "v": ("float", [float(i), 0.5])}
+                     for i in range(7)])
+    (d / "part-r-00001").write_bytes(b"")
+    _write_examples(d / "part-r-00002",
+                    [{"x": ("int64", [i]), "v": ("float", [float(i), 0.5])}
+                     for i in range(7, 12)])
+
+    batches = list(dfutil.iter_tfrecords_columnar(str(d), 4))
+    sizes = [len(b["x"]) for b in batches]
+    assert sizes == [4, 4, 4]
+    got = np.concatenate([b["x"] for b in batches])
+    assert got.tolist() == list(range(12))
+    assert batches[1]["v"].shape == (4, 2)
+
+    # short remainder kept by default, dropped on request
+    batches = list(dfutil.iter_tfrecords_columnar(str(d), 5))
+    assert [len(b["x"]) for b in batches] == [5, 5, 2]
+    batches = list(dfutil.iter_tfrecords_columnar(str(d), 5,
+                                                  drop_remainder=True))
+    assert [len(b["x"]) for b in batches] == [5, 5]
+
+    # streamed content == bulk loader content
+    bulk = dfutil.load_tfrecords_columnar(str(d))
+    assert bulk["x"].tolist() == list(range(12))
