@@ -201,6 +201,29 @@ def synchronized(it, feed=None):
         yield item
 
 
+def tfrecord_device_feed(source, batch_size, *, collate=None, depth=2,
+                         placement=None, drop_remainder=True):
+    """InputMode.TENSORFLOW fast path: stream TFRecord shards as dense
+    column batches (``dfutil.iter_tfrecords_columnar`` — one shard
+    resident at a time) straight into double-buffered device staging.
+
+        for x, y in tfrecord_device_feed(files, per_proc,
+                                         collate=my_collate):
+            params, ... = step_fn(params, ..., x, y)
+
+    ``collate({name: column_batch}) -> pytree`` (default: the dict as
+    is); ``drop_remainder`` defaults True so SPMD steps always see full
+    shapes.  ``source`` is a dir, file, or this worker's shard subset.
+    """
+    from tensorflowonspark_tpu import dfutil
+
+    it = dfutil.iter_tfrecords_columnar(source, batch_size,
+                                        drop_remainder=drop_remainder)
+    if collate is not None:
+        it = map(collate, it)
+    return prefetch_to_device(it, depth=depth, placement=placement)
+
+
 def device_feed(feed, batch_size, *, collate=None, depth=2, placement=None,
                 min_batch=None):
     """The composed fast path: DataFeed -> collate -> double-buffered

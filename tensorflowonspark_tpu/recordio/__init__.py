@@ -16,6 +16,7 @@ API:
 from __future__ import annotations
 
 import ctypes
+import os as _os
 
 import numpy as _np
 
@@ -222,6 +223,14 @@ def load_columnar(path):
     first record); ragged or schema-drifting files fall back to per-row
     ``decode_example`` with identical results.
     """
+    if _fs.is_local(path) and _os.path.isdir(_fs.local_path(path)):
+        # fopen(dir) "succeeds" with zero reads = silent empty result;
+        # a directory here is a caller mix-up (use dfutil's loaders for
+        # shard dirs)
+        raise IsADirectoryError(
+            f"{path} is a directory; pass a shard file (or use "
+            "dfutil.load_tfrecords_columnar / iter_tfrecords_columnar "
+            "for a shard dir)")
     lib = _native.load()
     if lib is None or not getattr(lib, "_tfos_colb_api", False):
         return _columnar_fallback(path)

@@ -124,3 +124,28 @@ def test_prefetch_clean_end_has_no_drain_penalty():
     # a fixed ~0.2s q.get poll per stream (>=1.0s over 5 streams); amortize
     # over several streams so one scheduler stall can't flake the bound
     assert dt < 0.75, f"5 clean ends took {dt:.3f}s"
+
+
+def test_tfrecord_device_feed_streams_to_device(tmp_path):
+    from tensorflowonspark_tpu import dfutil
+
+    from tensorflowonspark_tpu import recordio
+
+    d = tmp_path / "tfr"
+    d.mkdir()
+    rows = [{"x": [float(i), float(i)], "y": i} for i in range(20)]
+    for path, chunk in ((d / "part-r-00000", rows[:12]),
+                        (d / "part-r-00001", rows[12:])):
+        with recordio.TFRecordWriter(str(path)) as w:
+            for r in chunk:
+                w.write(dfutil.to_example(r))
+
+    got = list(infeed.tfrecord_device_feed(
+        [str(d / "part-r-00000"), str(d / "part-r-00001")], 8,
+        collate=lambda b: (np.asarray(b["x"]), np.asarray(b["y"])),
+    ))
+    assert len(got) == 2  # 20 rows -> 2 full batches, remainder dropped
+    xs = np.concatenate([np.asarray(x) for x, _ in got])
+    assert xs.shape == (16, 2)
+    ys = np.concatenate([np.asarray(y) for _, y in got])
+    assert sorted(ys.tolist()) == list(range(16))
