@@ -355,8 +355,8 @@ static bool parse_feature(const uint8_t* p, const uint8_t* end,
     int field = (int)(tag >> 3);
     uint64_t len;
     if (!get_varint(p, end, &len)) return false;
+    if (len > (uint64_t)(end - p)) return false;
     const uint8_t* lend = p + len;
-    if (lend > end) return false;
     // field ∈ {1,2,3} → the list message; inside: field 1 = values
     f->kind = field;
     const uint8_t* q = p;
@@ -369,14 +369,14 @@ static bool parse_feature(const uint8_t* p, const uint8_t* end,
       if (field == 1) {  // bytes values, wire 2
         uint64_t blen;
         if (!get_varint(q, lend, &blen)) return false;
-        if (q + blen > lend) return false;
+        if (blen > (uint64_t)(lend - q)) return false;
         f->bytes_vals.emplace_back((const char*)q, blen);
         q += blen;
       } else if (field == 2) {  // floats: packed (wire 2) or single (wire 5)
         if (vwire == 2) {
           uint64_t blen;
           if (!get_varint(q, lend, &blen)) return false;
-          if (q + blen > lend || blen % 4) return false;
+          if (blen > (uint64_t)(lend - q) || blen % 4) return false;
           size_t cnt = blen / 4;
           size_t base = f->float_vals.size();
           f->float_vals.resize(base + cnt);
@@ -395,8 +395,8 @@ static bool parse_feature(const uint8_t* p, const uint8_t* end,
         if (vwire == 2) {
           uint64_t blen;
           if (!get_varint(q, lend, &blen)) return false;
+          if (blen > (uint64_t)(lend - q)) return false;
           const uint8_t* vend = q + blen;
-          if (vend > lend) return false;
           while (q < vend) {
             uint64_t v;
             if (!get_varint(q, vend, &v)) return false;
@@ -431,8 +431,8 @@ ExampleDecoder* exd_parse(const uint8_t* data, uint64_t len) {
       if (wire != 2) goto fail;
       uint64_t len2;
       if (!get_varint(p, end, &len2)) goto fail;
+      if (len2 > (uint64_t)(end - p)) goto fail;
       const uint8_t* fend = p + len2;
-      if (fend > end) goto fail;
       if (field == 1) {  // Features
         const uint8_t* q = p;
         while (q < fend) {
@@ -441,8 +441,8 @@ ExampleDecoder* exd_parse(const uint8_t* data, uint64_t len) {
           if ((etag & 7) != 2 || (etag >> 3) != 1) goto fail;
           uint64_t elen;
           if (!get_varint(q, fend, &elen)) goto fail;
+          if (elen > (uint64_t)(fend - q)) goto fail;
           const uint8_t* eend = q + elen;
-          if (eend > fend) goto fail;
           DecodedFeature feat;
           feat.kind = 0;
           // map entry: key=1 (string), value=2 (Feature)
@@ -452,7 +452,7 @@ ExampleDecoder* exd_parse(const uint8_t* data, uint64_t len) {
             if (!get_varint(m, eend, &mtag)) goto fail;
             uint64_t mlen;
             if (!get_varint(m, eend, &mlen)) goto fail;
-            if (m + mlen > eend) goto fail;
+            if (mlen > (uint64_t)(eend - m)) goto fail;
             if ((mtag >> 3) == 1) {
               feat.name.assign((const char*)m, mlen);
             } else if ((mtag >> 3) == 2) {
@@ -543,8 +543,9 @@ static int64_t parse_feature_into(ColumnarBatch* cb, int c, int* kind,
     int field = (int)(tag >> 3);
     uint64_t len;
     if (!get_varint(p, end, &len)) return -1;
+    if (len > (uint64_t)(end - p)) return -1;
     const uint8_t* lend = p + len;
-    if (lend > end) return -1;
+    if (*kind != 0 && *kind != field) return -1;  // mixed-kind Feature
     *kind = field;
     const uint8_t* q = p;
     while (q < lend) {
@@ -555,7 +556,7 @@ static int64_t parse_feature_into(ColumnarBatch* cb, int c, int* kind,
       if (field == 1) {  // bytes
         uint64_t blen;
         if (vwire != 2 || !get_varint(q, lend, &blen)) return -1;
-        if (q + blen > lend) return -1;
+        if (blen > (uint64_t)(lend - q)) return -1;
         cb->bblobs[c].append((const char*)q, blen);
         cb->boffs[c].push_back(cb->bblobs[c].size());
         q += blen;
@@ -564,7 +565,7 @@ static int64_t parse_feature_into(ColumnarBatch* cb, int c, int* kind,
         if (vwire == 2) {
           uint64_t blen;
           if (!get_varint(q, lend, &blen)) return -1;
-          if (q + blen > lend || blen % 4) return -1;
+          if (blen > (uint64_t)(lend - q) || blen % 4) return -1;
           size_t cnt = blen / 4;
           auto& col = cb->fcols[c];
           size_t base = col.size();
@@ -586,8 +587,8 @@ static int64_t parse_feature_into(ColumnarBatch* cb, int c, int* kind,
         if (vwire == 2) {
           uint64_t blen;
           if (!get_varint(q, lend, &blen)) return -1;
+          if (blen > (uint64_t)(lend - q)) return -1;
           const uint8_t* vend = q + blen;
-          if (vend > lend) return -1;
           while (q < vend) {
             uint64_t v;
             if (!get_varint(q, vend, &v)) return -1;
@@ -631,8 +632,8 @@ static bool colb_add_record(ColumnarBatch* cb, const uint8_t* data,
     if ((tag & 7) != 2) return false;
     uint64_t len2;
     if (!get_varint(p, end, &len2)) return false;
+    if (len2 > (uint64_t)(end - p)) return false;
     const uint8_t* fend = p + len2;
-    if (fend > end) return false;
     if ((int)(tag >> 3) == 1) {  // Features
       const uint8_t* q = p;
       while (q < fend) {
@@ -641,8 +642,8 @@ static bool colb_add_record(ColumnarBatch* cb, const uint8_t* data,
         if ((etag & 7) != 2 || (etag >> 3) != 1) return false;
         uint64_t elen;
         if (!get_varint(q, fend, &elen)) return false;
+        if (elen > (uint64_t)(fend - q)) return false;
         const uint8_t* eend = q + elen;
-        if (eend > fend) return false;
         // map entry: key=1 (string), value=2 (Feature)
         const char* kname = nullptr;
         size_t klen = 0;
@@ -654,7 +655,7 @@ static bool colb_add_record(ColumnarBatch* cb, const uint8_t* data,
           if (!get_varint(m, eend, &mtag)) return false;
           uint64_t mlen;
           if (!get_varint(m, eend, &mlen)) return false;
-          if (m + mlen > eend) return false;
+          if (mlen > (uint64_t)(eend - m)) return false;
           if ((mtag >> 3) == 1) {
             kname = (const char*)m;
             klen = mlen;

@@ -1,0 +1,55 @@
+#!/bin/bash
+# One-command on-chip perf session (PERF.md's plan, in order):
+#
+#   1. ResNet-50 sweep (stem x batch x remat), promote the winner
+#   2. Profile the winning config -> PERF_BREAKDOWN.md (where time goes)
+#   3. Transformer sweep (batch x flash blocks x remat x bwd), promote
+#   4. Run bench.py with the promoted configs -> the round's JSON line
+#
+# Each step is its own process (the tunnel serializes TPU claims); a
+# step failing does not stop the later ones — partial results beat none.
+# Check tunnel liveness first: scripts print nothing for many minutes
+# during big compiles, which is normal (see CLAUDE.md).
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+if ! timeout 3 bash -c "echo > /dev/tcp/127.0.0.1/8082" 2>/dev/null; then
+  echo "WARNING: axon relay port 8082 closed - the TPU tunnel looks down" >&2
+fi
+
+log=${TFOS_PERF_LOG:-perf_session.log}
+echo "== tpu perf session $(date -u +%FT%TZ) ==" | tee -a "$log"
+
+run() {
+  echo "-- $* --" | tee -a "$log"
+  "$@" 2>&1 | tee -a "$log"
+  echo "-- rc=$? --" | tee -a "$log"
+}
+
+run python scripts/sweep_resnet.py --steps "${TFOS_SESSION_RESNET_STEPS:-20}" --image "${TFOS_SESSION_IMAGE:-224}" --promote
+run python scripts/profile_resnet.py --out PERF_BREAKDOWN.md \
+    --steps "${TFOS_SESSION_RESNET_STEPS:-10}" --image "${TFOS_SESSION_IMAGE:-224}" \
+    $(python - <<'EOF'
+import json, os
+cfg = {}
+if os.path.exists("bench_config.json"):
+    try:
+        cfg = json.load(open("bench_config.json"))
+    except ValueError:
+        pass
+args = []
+if cfg.get("batch"):
+    args += ["--batch", str(cfg["batch"])]
+if not cfg.get("stem_s2d", True):
+    args += ["--stem", "7x7"]
+if cfg.get("remat"):
+    args += ["--remat"]
+print(" ".join(args))
+EOF
+)
+run python scripts/sweep_transformer.py --steps "${TFOS_SESSION_TRANSFORMER_STEPS:-8}" --promote
+run python bench.py
+
+echo "== done; promoted config: ==" | tee -a "$log"
+cat bench_config.json 2>/dev/null | tee -a "$log" || \
+  echo "(no bench_config.json written)" | tee -a "$log"

@@ -224,14 +224,23 @@ def load_tfrecords_columnar(source):
 
 def _columnar_signature(shard):
     """name -> (kind, dtype, trailing shape): dtype/width drift across
-    shards must error, not silently upcast under np.concatenate."""
+    shards must error, not silently upcast under np.concatenate.  List
+    (bytes) columns distinguish flat (one value/record) from nested
+    (multi-value) so width drift errors there too instead of silently
+    mixing bytes with lists."""
     import numpy as np
 
-    return {
-        name: (kind, col.dtype.name, col.shape[1:])
-        if isinstance(col, np.ndarray) else (kind, "list", None)
-        for name, (kind, col) in shard.items()
-    }
+    def sig(kind, col):
+        if isinstance(col, np.ndarray):
+            return (kind, col.dtype.name, col.shape[1:])
+        # scan the whole column: col[0] alone mislabels a ragged
+        # fallback column whose first record happened to be single-value
+        n_lists = sum(1 for v in col if isinstance(v, list))
+        shape = ("flat" if n_lists == 0
+                 else "nested" if n_lists == len(col) else "ragged")
+        return (kind, "list", shape)
+
+    return {name: sig(kind, col) for name, (kind, col) in shard.items()}
 
 
 def iter_tfrecords_columnar(source, batch_size, *, drop_remainder=False):

@@ -361,3 +361,60 @@ def test_iter_columnar_streams_batches(tmp_path):
     # streamed content == bulk loader content
     bulk = dfutil.load_tfrecords_columnar(str(d))
     assert bulk["x"].tolist() == list(range(12))
+
+
+def test_mixed_kind_feature_rejected_by_columnar():
+    """A Feature whose wire encoding mixes kinds (float_list then
+    int64_list under one key) must NOT be columnized — the per-kind
+    buffers would disagree with the summed count and the reshape would
+    read out of bounds."""
+    # hand-build the wire bytes: Example{features{feature{key:"x",
+    # value{float_list{1.0} int64_list{1,2}}}}}
+    def varint(v):
+        out = b""
+        while v >= 0x80:
+            out += bytes([v & 0x7F | 0x80])
+            v >>= 7
+        return out + bytes([v])
+
+    def ld(field, payload):  # length-delimited
+        return varint(field << 3 | 2) + varint(len(payload)) + payload
+
+    import struct
+
+    floats = ld(1, struct.pack("<f", 1.0))          # FloatList.value
+    ints = ld(1, varint(1) + varint(2))             # Int64List.value packed
+    feature = ld(2, floats) + ld(3, ints)           # mixed kinds!
+    entry = ld(1, b"x") + ld(2, feature)
+    example = ld(1, ld(1, entry))
+
+    path = None
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        import os
+
+        path = os.path.join(tmp, "part-r-00000")
+        with recordio.TFRecordWriter(path) as w:
+            w.write(example)
+        lib = native.load()
+        if lib is not None and getattr(lib, "_tfos_colb_api", False):
+            h = lib.tfr_load_columnar(path.encode())
+            try:
+                assert not lib.colb_ok(h)  # rejected, falls back per-row
+            finally:
+                lib.colb_free(h)
+        # the public API survives via the row fallback (dict last-kind)
+        cols = recordio.load_columnar(path)
+        assert "x" in cols
+
+
+def test_bytes_width_drift_across_shards_raises(tmp_path):
+    d = tmp_path / "tfr"
+    d.mkdir()
+    _write_examples(d / "part-r-00000",
+                    [{"tags": ("bytes", [b"a"])}])        # flat
+    _write_examples(d / "part-r-00001",
+                    [{"tags": ("bytes", [b"b", b"c"])}])  # nested
+    with pytest.raises(ValueError, match="schema"):
+        dfutil.load_tfrecords_columnar(str(d))
