@@ -123,9 +123,13 @@ def prefetch_to_device(it, depth=2, placement=None, on_abandon=None):
                     if not t.is_alive():
                         break
                     # a live-but-idle worker is blocked in the source and
-                    # will never produce once cancelled: stop burning time
+                    # will never produce once cancelled: stop burning time.
+                    # With an on_abandon hook give the source one extra
+                    # poll to unblock (poison slices are not instant), but
+                    # never pay the full drain deadline on an idle worker —
+                    # the join + daemon warning below covers a stuck one
                     idle_polls += 1
-                    if idle_polls >= 2 and on_abandon is None:
+                    if idle_polls >= (3 if on_abandon is not None else 2):
                         break
                     continue
                 idle_polls = 0

@@ -175,10 +175,28 @@ class TFNodeContext:
         env["slice_health"] = health
         if not health["healthy"]:
             logger.error("slice health check failed: %s", health["errors"])
+            # TFOS_SLICE_HEALTH modes:
+            #   lenient (default) — definite findings (wrong device
+            #     counts, CPU fallback, smoke failure) are fatal; a probe
+            #     that merely TIMED OUT with nothing else found is
+            #     warn-only, because first TPU contact through a slow
+            #     pool/tunnel can exceed any fixed window (widen via
+            #     TFOS_SLICE_HEALTH_TIMEOUT).
+            #   strict — everything fatal, including probe timeouts:
+            #     fail-fast for deployments that prefer a bring-up error
+            #     over a possible hang in the first collective.
+            #   warn — log only, never fatal.
+            mode = os.environ.get(
+                "TFOS_SLICE_HEALTH", "lenient").strip().lower()
+            if mode not in ("strict", "lenient", "warn"):
+                logger.warning(
+                    "unknown TFOS_SLICE_HEALTH=%r; treating as 'lenient' "
+                    "(valid: strict|lenient|warn)", mode)
+                mode = "lenient"
+            only_timeout = health.get("bare_timeout", False)
             # raising here routes through the node wrapper's exception
-            # path onto the error queue, which the feeder/driver observe;
-            # TFOS_SLICE_HEALTH=warn downgrades to the log line only
-            if os.environ.get("TFOS_SLICE_HEALTH", "strict") != "warn":
+            # path onto the error queue, which the feeder/driver observe
+            if mode != "warn" and not (only_timeout and mode == "lenient"):
                 raise RuntimeError(
                     f"unhealthy accelerator slice: {health['errors']}")
         else:

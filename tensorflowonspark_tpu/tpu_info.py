@@ -208,7 +208,7 @@ def local_device_info():
 
 
 def slice_health(expected_processes=None, expected_local_devices=None,
-                 smoke=True, timeout=60):
+                 smoke=True, timeout=None):
     """Health-check the accelerator slice from a live JAX backend.
 
     The new-build counterpart of the reference's implicit "TF server came
@@ -219,15 +219,43 @@ def slice_health(expected_processes=None, expected_local_devices=None,
     computation executes on every local device.  Returns a dict with
     ``healthy`` plus details; never raises and never hangs past
     ``timeout`` — callers decide whether a sick slice is fatal.
+
+    ``timeout`` defaults to ``TFOS_SLICE_HEALTH_TIMEOUT`` (seconds, 60 if
+    unset) — first TPU contact through a slow pool/tunnel can legitimately
+    exceed a fixed window, so deployments can widen it without code
+    changes.  A probe that is merely *slow* is reported distinctly: the
+    returned dict's ``timed_out`` flag is set and the probe's findings so
+    far are snapshotted, letting callers treat "no answer yet" differently
+    from definite failures (wrong counts, CPU fallback, smoke failure).
     """
+    import copy
     import threading
 
-    report = {
+    if timeout is None:
+        try:
+            timeout = float(os.environ.get("TFOS_SLICE_HEALTH_TIMEOUT", 60))
+        except ValueError:
+            timeout = float("nan")
+        if not (timeout > 0):  # rejects nan, 0, negatives
+            logger.warning("bad TFOS_SLICE_HEALTH_TIMEOUT=%r; using 60",
+                           os.environ.get("TFOS_SLICE_HEALTH_TIMEOUT"))
+            timeout = 60.0
+    # 'inf' / huge values would make t.join() raise OverflowError,
+    # breaking the never-raises contract — cap at what join() accepts
+    timeout = min(timeout, threading.TIMEOUT_MAX)
+
+    # the probe thread mutates ``work`` under ``lock``; the caller gets a
+    # snapshot taken after join(), so a probe that outlives the timeout
+    # can never mutate the dict the caller is already reading
+    lock = threading.Lock()
+    work = {
         "healthy": False,
         "platform": None,
         "local_devices": 0,
         "global_devices": 0,
         "process_index": None,
+        "timed_out": False,
+        "bare_timeout": False,
         "errors": [],
     }
 
@@ -235,42 +263,56 @@ def slice_health(expected_processes=None, expected_local_devices=None,
     # FIRST jax call (backend-client creation) is a common hang point,
     # not just the smoke compute — a hang must become a report, not
     # wedge bring-up
+    def err(msg):
+        # flush each finding under the lock AS FOUND: a probe that later
+        # hangs (e.g. in the smoke compute) must not take already-detected
+        # definite failures down with it — the caller's timeout snapshot
+        # includes everything known so far
+        with lock:
+            work["errors"].append(msg)
+
     def probe():
         try:
             import jax
 
+            # all jax calls OUTSIDE the lock: a backend that wedges
+            # mid-call must not wedge the caller's snapshot deepcopy too
             devs = jax.local_devices()
-            report["platform"] = devs[0].platform if devs else None
-            report["local_devices"] = len(devs)
-            report["global_devices"] = jax.device_count()
-            report["process_index"] = jax.process_index()
+            platform = devs[0].platform if devs else None
+            n_global = jax.device_count()
+            proc_idx = jax.process_index()
+            with lock:
+                work["platform"] = platform
+                work["local_devices"] = len(devs)
+                work["global_devices"] = n_global
+                work["process_index"] = proc_idx
             if not devs:
-                report["errors"].append("no local devices visible")
+                err("no local devices visible")
                 return
             plats = os.environ.get("JAX_PLATFORMS", "").lower()
             forced_cpu = (
                 plats.split(",")[0].strip() == "cpu"  # incl. "cpu,tpu"
                 or os.environ.get("JAX_PLATFORM_NAME", "").lower() == "cpu"
             )
-            if report["platform"] == "cpu" and not forced_cpu \
+            if platform == "cpu" and not forced_cpu \
                     and count_chips() > 0:
                 # libtpu failed to load and jax silently fell back to
                 # host CPU — counts all match, but this is not the slice.
                 # An explicit JAX_PLATFORMS=cpu is an intentional choice
                 # (tests run forced-cpu on TPU VMs while a bench owns the
                 # chips), not a fallback.
-                report["errors"].append(
+                err(
                     f"{count_chips()} TPU chips present on this host but "
                     "the jax backend is 'cpu' (accelerator runtime failed "
                     "to initialize?)")
             if expected_local_devices is not None and \
                     len(devs) != expected_local_devices:
-                report["errors"].append(
+                err(
                     f"local devices {len(devs)} != expected "
                     f"{expected_local_devices}")
             if expected_processes is not None and \
                     jax.process_count() != expected_processes:
-                report["errors"].append(
+                err(
                     f"process count {jax.process_count()} != expected "
                     f"{expected_processes}")
             # global cross-check: slices are homogeneous, so even without
@@ -278,9 +320,9 @@ def slice_health(expected_processes=None, expected_local_devices=None,
             # as global != processes x local
             want = ((expected_processes or jax.process_count())
                     * (expected_local_devices or len(devs)))
-            if report["global_devices"] != want:
-                report["errors"].append(
-                    f"global devices {report['global_devices']} != expected "
+            if n_global != want:
+                err(
+                    f"global devices {n_global} != expected "
                     f"{want} (a peer host may be short of chips)")
             if smoke:
                 import numpy as np
@@ -290,18 +332,31 @@ def slice_health(expected_processes=None, expected_local_devices=None,
                 for d in devs:
                     got = jax.device_put(np.int32(20), d) + 22
                     if int(got) != 42:
-                        report["errors"].append(
+                        err(
                             f"device {d.id} smoke compute returned "
                             f"{int(got)}")
         except Exception as e:  # noqa: BLE001 - report, never raise
-            report["errors"].append(f"{type(e).__name__}: {str(e)[:160]}")
+            err(f"{type(e).__name__}: {str(e)[:160]}")
+        finally:
+            with lock:
+                work["done"] = True
 
     t = threading.Thread(target=probe, daemon=True, name="tfos-slice-health")
     t.start()
     t.join(timeout=timeout)
-    if t.is_alive():
+    with lock:
+        report = copy.deepcopy(work)
+    # ``report`` is now a private snapshot: a probe thread that outlives
+    # the timeout can keep mutating ``work`` without the caller observing
+    # fields change under it
+    if not report.pop("done", False):
+        report["timed_out"] = True
+        # explicit "slow but nothing definite found" signal: callers
+        # branch on this, not on the error-list composition
+        report["bare_timeout"] = not report["errors"]
         report["errors"].append(
             f"health probe still hung after {timeout}s (wedged backend "
-            "or device?)")
+            "or device, or a first-contact compile slower than "
+            "TFOS_SLICE_HEALTH_TIMEOUT?)")
     report["healthy"] = not report["errors"]
     return report

@@ -111,6 +111,32 @@ def test_prefetch_abandon_poisons_source_and_reaps_worker():
     assert not live, f"prefetch worker leaked: {live}"
 
 
+def test_prefetch_abandon_idle_exit_with_failing_hook():
+    """ADVICE r3: when the on_abandon hook does NOT unblock the source
+    (here: it raises), the drain loop must take the idle-worker early
+    exit instead of paying the full 3s drain deadline + 2s join."""
+    import time
+
+    def forever():
+        yield np.zeros(2)
+        # just above the ~2.6s drain window: blocks the worker for the
+        # test without leaking a 30s daemon into later thread-leak checks
+        time.sleep(6)
+        yield np.zeros(2)
+
+    def bad_hook():
+        raise RuntimeError("hook failed to unblock the source")
+
+    it = infeed.prefetch_to_device(forever(), depth=2, on_abandon=bad_hook)
+    next(it)
+    t0 = time.monotonic()
+    it.close()
+    dt = time.monotonic() - t0
+    # early exit: ~3 idle polls (0.6s) + join(2) = ~2.6s; the old path
+    # paid the full 3s deadline first (~5s)
+    assert dt < 4, f"abandon with failing hook took {dt:.2f}s"
+
+
 def test_prefetch_clean_end_has_no_drain_penalty():
     import time
 

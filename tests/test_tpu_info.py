@@ -198,6 +198,102 @@ def test_unhealthy_slice_is_fatal_at_bring_up(monkeypatch):
     assert env["slice_health"] is sick  # reported, not fatal
 
 
+def test_slice_health_timeout_env_and_snapshot(monkeypatch):
+    """ADVICE r3: the probe window is env-tunable via
+    TFOS_SLICE_HEALTH_TIMEOUT, a hung probe sets ``timed_out``, and the
+    returned dict is a snapshot the late-finishing probe cannot mutate."""
+    import time
+
+    from tensorflowonspark_tpu import tpu_info
+
+    # force the probe to out-sleep a tiny env-provided window
+    real_local_devices = __import__("jax").local_devices
+
+    def slow_local_devices():
+        time.sleep(2)
+        return real_local_devices()
+
+    monkeypatch.setattr(__import__("jax"), "local_devices",
+                        slow_local_devices)
+    monkeypatch.setenv("TFOS_SLICE_HEALTH_TIMEOUT", "0.2")
+    h = tpu_info.slice_health(expected_processes=1,
+                              expected_local_devices=8)
+    assert h["timed_out"] and not h["healthy"]
+    assert any("TFOS_SLICE_HEALTH_TIMEOUT" in e for e in h["errors"])
+    n_errors = len(h["errors"])
+    time.sleep(2.5)  # let the probe finish in the background
+    # snapshot: the caller's dict must not have changed under it
+    assert len(h["errors"]) == n_errors and "done" not in h
+
+
+def test_probe_timeout_is_warn_only_at_bring_up(monkeypatch):
+    """ADVICE r3 (medium): a probe that merely timed out (slow pool /
+    first-contact compile) must NOT hard-fail bring-up; definite errors
+    still must (covered by test_unhealthy_slice_is_fatal_at_bring_up)."""
+    from tensorflowonspark_tpu import node as N
+    from tensorflowonspark_tpu import tpu_info
+
+    ctx = N.TFNodeContext.__new__(N.TFNodeContext)
+    monkeypatch.setattr(
+        N.TFNodeContext, "distributed_env",
+        lambda self: {"num_processes": 1, "process_id": 0,
+                      "coordinator_address": "127.0.0.1:1"})
+    slow = {"healthy": False, "timed_out": True, "bare_timeout": True,
+            "errors": ["health probe still hung after 0.2s"],
+            "local_devices": 0, "global_devices": 0, "platform": None,
+            "process_index": None}
+    monkeypatch.setattr(tpu_info, "slice_health", lambda **kw: slow)
+    env = N.TFNodeContext.jax_initialize(ctx)  # must not raise
+    assert env["slice_health"] is slow
+
+
+def test_probe_timeout_fatal_in_strict_mode(monkeypatch):
+    """TFOS_SLICE_HEALTH=strict keeps probe timeouts fatal: fail-fast
+    beats a possible wedge in the first collective for deployments that
+    opt into it."""
+    import pytest
+
+    from tensorflowonspark_tpu import node as N
+    from tensorflowonspark_tpu import tpu_info
+
+    ctx = N.TFNodeContext.__new__(N.TFNodeContext)
+    monkeypatch.setattr(
+        N.TFNodeContext, "distributed_env",
+        lambda self: {"num_processes": 1, "process_id": 0,
+                      "coordinator_address": "127.0.0.1:1"})
+    slow = {"healthy": False, "timed_out": True, "bare_timeout": True,
+            "errors": ["health probe still hung after 0.2s"],
+            "local_devices": 0, "global_devices": 0, "platform": None,
+            "process_index": None}
+    monkeypatch.setattr(tpu_info, "slice_health", lambda **kw: slow)
+    monkeypatch.setenv("TFOS_SLICE_HEALTH", "strict")
+    with pytest.raises(RuntimeError, match="unhealthy accelerator slice"):
+        ctx.jax_initialize()
+
+
+def test_definite_errors_survive_a_hung_probe(monkeypatch):
+    """Errors found BEFORE the probe hangs must appear in the timeout
+    snapshot (flushed under the lock as found), so a definitely-broken
+    slice is never downgraded to a bare timeout."""
+    import time
+
+    import jax
+
+    from tensorflowonspark_tpu import tpu_info
+
+    def hang_device_put(x, d):
+        time.sleep(5)
+        return __import__("numpy").int32(42)
+
+    jax.local_devices()  # warm the backend so 0.4s is all probe time
+    monkeypatch.setattr(jax, "device_put", hang_device_put)
+    monkeypatch.setenv("TFOS_SLICE_HEALTH_TIMEOUT", "0.4")
+    h = tpu_info.slice_health(expected_processes=7)  # wrong on purpose
+    assert h["timed_out"]
+    assert any("process count" in e for e in h["errors"]), h["errors"]
+    assert len(h["errors"]) >= 2  # definite finding + timeout message
+
+
 def test_slice_health_flags_silent_cpu_fallback(monkeypatch):
     """TPU chips present + jax backend 'cpu' without an explicit
     JAX_PLATFORMS=cpu means the accelerator runtime failed to load —
