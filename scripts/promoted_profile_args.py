@@ -1,0 +1,27 @@
+"""Emit profile_resnet.py CLI args for the promoted bench config (single
+source of truth: bench.bench_config_path / bench._promoted_config).
+Used by tpu_perf_session.sh so the shell never re-implements the config
+path resolution."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import bench
+
+    cfg = bench._promoted_config()
+    args = []
+    if cfg.get("batch"):
+        args += ["--batch", str(cfg["batch"])]
+    if not cfg.get("stem_s2d", True):
+        args += ["--stem", "7x7"]
+    if cfg.get("remat"):
+        args += ["--remat"]
+    print(" ".join(args))
+
+
+if __name__ == "__main__":
+    main()

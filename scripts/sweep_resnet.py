@@ -17,6 +17,26 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
 
+# (name, batch, stem_s2d, remat) — most promising first, so a flaky
+# tunnel session still yields the configs that matter.  Module-level so
+# dry-run tests can substitute tiny shapes while driving the REAL
+# sweep/promote/refusal paths.
+CONFIGS = [
+    ("b512_s2d", 512, True, False),
+    ("b256_s2d", 256, True, False),
+    ("b512_s2d_remat", 512, True, True),
+    ("b1024_s2d_remat", 1024, True, True),
+    ("b256_7x7", 256, False, False),
+]
+
+
+def config_path():
+    """bench_config.json location — resolved by bench.bench_config_path
+    (the single source of truth; TFOS_BENCH_CONFIG overrides)."""
+    import bench
+
+    return bench.bench_config_path()
+
 
 def measure(step_fn, params, state, opt_state, images, labels, steps):
     import jax
@@ -73,20 +93,16 @@ def main():
     jax.block_until_ready(params)
     print("init done", flush=True)
 
-    configs = [
-        # (name, batch, stem_s2d, remat) — most promising first, so a
-        # flaky tunnel session still yields the configs that matter
-        ("b512_s2d", 512, True, False),
-        ("b256_s2d", 256, True, False),
-        ("b512_s2d_remat", 512, True, True),
-        ("b1024_s2d_remat", 1024, True, True),
-        ("b256_7x7", 256, False, False),
-    ]
+    configs = list(CONFIGS)
     subset = os.environ.get("TFOS_SWEEP")
     if subset:
         want = set(subset.split(","))
         configs = [c for c in configs if c[0] in want]
-    if os.environ.get("TFOS_SWEEP_SMOKE") == "1":  # plumbing check (CPU)
+    # SMOKE: plumbing check (CPU) — tiny shapes AND promote refused.
+    # TINY: tiny shapes only — promote logic still runs, so fake-TPU
+    # dry-run tests can drive the real promote/merge/refusal branches.
+    if os.environ.get("TFOS_SWEEP_SMOKE") == "1" \
+            or os.environ.get("TFOS_SWEEP_TINY") == "1":
         configs = [(n, 4, s, r) for n, _, s, r in configs[:2]]
 
     rng = np.random.default_rng(0)
@@ -117,15 +133,18 @@ def main():
     if args.promote and results:
         import json
 
-        if os.environ.get("TFOS_SWEEP_SMOKE") == "1" or \
+        tiny = os.environ.get("TFOS_SWEEP_TINY") == "1" and \
+            os.environ.get("TFOS_SWEEP_TINY_PROMOTE_OK") != "1"
+        if os.environ.get("TFOS_SWEEP_SMOKE") == "1" or tiny or \
                 dev.platform == "cpu":
-            print("promote skipped: smoke/CPU runs must not pin the TPU "
-                  "bench to toy shapes", flush=True)
+            # TINY shrinks configs to toy shapes too: a leftover env var
+            # during a live claim must not pin the bench to batch 4
+            # (dry-run tests set TFOS_SWEEP_TINY_PROMOTE_OK explicitly)
+            print("promote skipped: smoke/CPU/tiny runs must not pin the "
+                  "TPU bench to toy shapes", flush=True)
             return
         best_mfu, best = max(results)
-        path = os.path.join(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            "bench_config.json")
+        path = config_path()
         cfg_all = {}
         if os.path.exists(path):  # keep other sections (e.g. transformer)
             try:

@@ -16,6 +16,29 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
 
+# (name, batch, block_q, block_kv, remat, bwd) — module-level so dry-run
+# tests can substitute tiny shapes while driving the REAL promote paths.
+CONFIGS = [
+    ("b16_q512_kv512", 16, 512, 512, False, "xla"),
+    ("b16_q512_kv512_pbwd", 16, 512, 512, False, "pallas"),
+    ("b8_q512_kv512", 8, 512, 512, False, "xla"),
+    ("b16_q1024_kv512", 16, 1024, 512, False, "xla"),
+    ("b16_q512_kv1024", 16, 512, 1024, False, "xla"),
+    ("b16_q1024_kv1024", 16, 1024, 1024, False, "xla"),
+    ("b32_q512_kv512", 32, 512, 512, False, "xla"),
+    ("b32_q512_kv512_remat", 32, 512, 512, True, "xla"),
+    ("b32_q512_kv512_remat_pbwd", 32, 512, 512, True, "pallas"),
+    ("b64_q512_kv512_remat", 64, 512, 512, True, "xla"),
+]
+
+
+def config_path():
+    """bench_config.json location — resolved by bench.bench_config_path
+    (the single source of truth; TFOS_BENCH_CONFIG overrides)."""
+    import bench
+
+    return bench.bench_config_path()
+
 
 def main():
     ap = argparse.ArgumentParser()
@@ -35,13 +58,16 @@ def main():
     from tensorflowonspark_tpu.utils import metrics as M
 
     smoke = os.environ.get("TFOS_SWEEP_SMOKE") == "1"
+    # TINY shrinks shapes like smoke but leaves the promote logic live
+    # (fake-TPU dry-run tests drive the real promote/merge branches)
+    tiny = smoke or os.environ.get("TFOS_SWEEP_TINY") == "1"
     cfg = transformer.Config(
-        vocab_size=512 if smoke else 16384,
-        dim=128 if smoke else 1024,
-        n_layers=2 if smoke else 8,
-        n_heads=4 if smoke else 8,
-        max_seq=256 if smoke else 2048,
-        dtype="float32" if smoke else "bfloat16",
+        vocab_size=512 if tiny else 16384,
+        dim=128 if tiny else 1024,
+        n_layers=2 if tiny else 8,
+        n_heads=4 if tiny else 8,
+        max_seq=256 if tiny else 2048,
+        dtype="float32" if tiny else "bfloat16",
         attn_impl="flash",
     )
     peak = 197e12
@@ -58,24 +84,12 @@ def main():
     jax.block_until_ready(params)
     print("init done", flush=True)
 
-    configs = [
-        # (name, batch, block_q, block_kv, remat, bwd)
-        ("b16_q512_kv512", 16, 512, 512, False, "xla"),
-        ("b16_q512_kv512_pbwd", 16, 512, 512, False, "pallas"),
-        ("b8_q512_kv512", 8, 512, 512, False, "xla"),
-        ("b16_q1024_kv512", 16, 1024, 512, False, "xla"),
-        ("b16_q512_kv1024", 16, 512, 1024, False, "xla"),
-        ("b16_q1024_kv1024", 16, 1024, 1024, False, "xla"),
-        ("b32_q512_kv512", 32, 512, 512, False, "xla"),
-        ("b32_q512_kv512_remat", 32, 512, 512, True, "xla"),
-        ("b32_q512_kv512_remat_pbwd", 32, 512, 512, True, "pallas"),
-        ("b64_q512_kv512_remat", 64, 512, 512, True, "xla"),
-    ]
+    configs = list(CONFIGS)
     subset = os.environ.get("TFOS_SWEEP")
     if subset:
         want = set(subset.split(","))
         configs = [c for c in configs if c[0] in want]
-    if smoke:  # plumbing check (CPU): tiny batch, blocks fitting
+    if tiny:  # plumbing check (CPU): tiny batch, blocks fitting
         # max_seq, always including one remat and one pallas-bwd config
         picked = (configs[:2] + [c for c in configs[2:] if c[4]][:1]
                   + [c for c in configs[2:] if c[5] == "pallas"][:1])
@@ -126,14 +140,17 @@ def main():
     if args.promote and results:
         import json
 
-        if smoke or jax.devices()[0].platform == "cpu":
-            print("promote skipped: smoke/CPU runs must not pin the TPU "
-                  "bench to toy shapes", flush=True)
+        tiny_guard = tiny and \
+            os.environ.get("TFOS_SWEEP_TINY_PROMOTE_OK") != "1"
+        if smoke or tiny_guard or jax.devices()[0].platform == "cpu":
+            # TINY shrinks shapes too (see sweep_resnet.py): only the
+            # dry-run tests may promote tiny results, via the explicit
+            # TFOS_SWEEP_TINY_PROMOTE_OK acknowledgement
+            print("promote skipped: smoke/CPU/tiny runs must not pin the "
+                  "TPU bench to toy shapes", flush=True)
             return
         best_mfu, best = max(results)
-        path = os.path.join(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            "bench_config.json")
+        path = config_path()
         cfg_all = {}
         if os.path.exists(path):  # keep the resnet section
             try:

@@ -20,6 +20,16 @@ fi
 log=${TFOS_PERF_LOG:-perf_session.log}
 echo "== tpu perf session $(date -u +%FT%TZ) ==" | tee -a "$log"
 
+# TFOS_SESSION_SMOKE=1: CPU dry run of the WHOLE session pipeline (tiny
+# shapes, promote refused by the sweeps, bench skipped) so script bugs
+# surface here, not in the first minutes of a live chip claim.
+profile_extra=""
+if [ "${TFOS_SESSION_SMOKE:-0}" = "1" ]; then
+  export TFOS_SWEEP_SMOKE=1
+  profile_extra="--batch 4"
+  echo "(smoke mode: tiny shapes, no promote, bench skipped)" | tee -a "$log"
+fi
+
 run() {
   echo "-- $* --" | tee -a "$log"
   "$@" 2>&1 | tee -a "$log"
@@ -27,29 +37,20 @@ run() {
 }
 
 run python scripts/sweep_resnet.py --steps "${TFOS_SESSION_RESNET_STEPS:-20}" --image "${TFOS_SESSION_IMAGE:-224}" --promote
-run python scripts/profile_resnet.py --out PERF_BREAKDOWN.md \
+# promoted-config args come first so $profile_extra (smoke mode's
+# --batch 4) wins argparse's last-takes-effect — a CPU dry run must
+# never profile at a previously promoted TPU batch size
+run python scripts/profile_resnet.py --out "${TFOS_SESSION_BREAKDOWN:-PERF_BREAKDOWN.md}" \
     --steps "${TFOS_SESSION_RESNET_STEPS:-10}" --image "${TFOS_SESSION_IMAGE:-224}" \
-    $(python - <<'EOF'
-import json, os
-cfg = {}
-if os.path.exists("bench_config.json"):
-    try:
-        cfg = json.load(open("bench_config.json"))
-    except ValueError:
-        pass
-args = []
-if cfg.get("batch"):
-    args += ["--batch", str(cfg["batch"])]
-if not cfg.get("stem_s2d", True):
-    args += ["--stem", "7x7"]
-if cfg.get("remat"):
-    args += ["--remat"]
-print(" ".join(args))
-EOF
-)
+    $(python scripts/promoted_profile_args.py) \
+    $profile_extra
 run python scripts/sweep_transformer.py --steps "${TFOS_SESSION_TRANSFORMER_STEPS:-8}" --promote
-run python bench.py
+if [ "${TFOS_SESSION_SMOKE:-0}" = "1" ]; then
+  echo "-- bench.py skipped (smoke mode) --" | tee -a "$log"
+else
+  run python bench.py
+fi
 
 echo "== done; promoted config: ==" | tee -a "$log"
-cat bench_config.json 2>/dev/null | tee -a "$log" || \
+cat "${TFOS_BENCH_CONFIG:-bench_config.json}" 2>/dev/null | tee -a "$log" || \
   echo "(no bench_config.json written)" | tee -a "$log"

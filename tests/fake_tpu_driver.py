@@ -1,0 +1,56 @@
+"""Dry-run driver for the perf sweep scripts (VERDICT r3 next #6a).
+
+Runs scripts/sweep_resnet.py or scripts/sweep_transformer.py in-process
+with tiny shapes (TFOS_SWEEP_TINY, set by the caller) and — when asked —
+a FAKED TPU device identity, so the promote/merge/refusal branches that
+normally only execute during a live chip claim are exercised off-chip.
+Real file with a __main__ guard (spawn start method; CLAUDE.md).
+
+Usage: python fake_tpu_driver.py {sweep_resnet|sweep_transformer}
+                                 {faketpu|cpu} [script args...]
+"""
+
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeTpuDevice:
+    """Quacks like a jax TPU device for identity checks; computation
+    still runs on the genuine default (CPU) backend."""
+
+    platform = "tpu"
+    device_kind = "TPU v5e (faked for dry-run)"
+    id = 0
+
+    def __repr__(self):
+        return "FakeTpuDevice(TPU v5e, dry-run)"
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main():
+    which, mode, rest = sys.argv[1], sys.argv[2], sys.argv[3:]
+    assert which in ("sweep_resnet", "sweep_transformer"), which
+    assert mode in ("faketpu", "cpu"), mode
+
+    import jax
+
+    if mode == "faketpu":
+        jax.devices = lambda *a, **k: [FakeTpuDevice()]
+
+    mod = _load_script(which)
+    sys.argv = [which + ".py"] + rest
+    mod.main()
+
+
+if __name__ == "__main__":
+    main()
