@@ -150,12 +150,21 @@ def _part_files(input_dir):
     return files
 
 
-def load_tfrecords(source, input_dir, binary_features=()):
+def load_tfrecords(source, input_dir, binary_features=(), min_partitions=None):
     """Load TFRecords into a dataset of row dicts with an inferred schema
     (parity: dfutil.loadTFRecords :44-81).
 
     ``source``: an engine (LocalEngine/SparkEngine) used to parallelize
     the shard list; pass None for a plain list of rows.
+
+    ``min_partitions``: when the directory has fewer shard FILES than
+    this (typical: fewer shards than feeder workers, which would starve
+    workers and trigger the synchronized stop at step 0), each file is
+    STRIPED across ceil(min_partitions/len(files)) read units — unit
+    ``(path, stride, offset)`` keeps records where ``index % stride ==
+    offset``.  Every unit still scans its whole file (TFRecords have no
+    index), but nothing materializes through the driver, unlike
+    ``Dataset.repartition`` on the local engine.
     """
     files = _part_files(input_dir)
 
@@ -164,16 +173,31 @@ def load_tfrecords(source, input_dir, binary_features=()):
 
     def read_shard(it):
         out = []
-        for path in it:
-            for rec in recordio.TFRecordReader(path):
-                out.append(from_example(rec, schema, binary_features))
+        for unit in it:
+            path, stride, offset = (
+                unit if isinstance(unit, tuple) else (unit, 1, 0))
+            for i, rec in enumerate(recordio.TFRecordReader(path)):
+                if stride == 1 or i % stride == offset:
+                    out.append(from_example(rec, schema, binary_features))
         return out
 
     if source is None:
         rows = list(read_shard(iter(files)))
         loaded_schemas[input_dir] = schema
         return rows, schema
-    ds = source.parallelize(files, min(len(files), source.num_executors * 2))
+    if min_partitions and len(files) < min_partitions:
+        stripes = -(-min_partitions // len(files))  # ceil
+        units = [(f, stripes, off) for f in files for off in range(stripes)]
+        logger.info(
+            "striping %d shard file(s) into %d read units to reach "
+            "min_partitions=%d (each unit rescans its file, keeping "
+            "1/%d of the records)",
+            len(files), len(units), min_partitions, stripes)
+    else:
+        units = list(files)
+    n_parts = min(len(units),
+                  max(source.num_executors * 2, min_partitions or 0))
+    ds = source.parallelize(units, n_parts)
     ds = ds.map_partitions(read_shard)
     loaded_schemas[input_dir] = schema
     return ds, schema

@@ -54,6 +54,44 @@ class TaskError(RuntimeError):
     """A task raised on an executor; carries the remote traceback."""
 
 
+def _row_bytes(row, _depth=0):
+    """Approximate in-memory payload size of one row (bytes/ndarray-aware,
+    two levels deep into containers — enough for (image, label) tuples and
+    feature dicts without walking arbitrary object graphs)."""
+    if isinstance(row, (bytes, bytearray, str)):
+        return len(row)
+    nbytes = getattr(row, "nbytes", None)
+    if nbytes is not None:
+        return int(nbytes)
+    if _depth < 2 and isinstance(row, (list, tuple, dict)):
+        vals = row.values() if isinstance(row, dict) else row
+        return 64 + sum(_row_bytes(v, _depth + 1) for v in vals)
+    import sys
+
+    try:
+        return sys.getsizeof(row)
+    except TypeError:
+        return 64
+
+
+def _approx_bytes(rows, sample=200):
+    """Estimated total payload bytes of ``rows`` from a strided sample."""
+    if not rows:
+        return 0
+    k = min(sample, len(rows))
+    stride = len(rows) // k
+    sampled = sum(_row_bytes(rows[i * stride]) for i in range(k))
+    return int(sampled * len(rows) / k)
+
+
+def _fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{n} B"
+        n /= 1024
+    return f"{n:.1f} GiB"
+
+
 # ----------------------------------------------------------------------------
 # Executor worker process
 # ----------------------------------------------------------------------------
@@ -193,12 +231,28 @@ class LocalDataset:
         ``repartition`` parity).  Needed when a feed source has fewer
         partitions than executors — InputMode.SPARK feeds one partition
         per feeder task, so a starved worker would trigger the
-        synchronized global-stop at step 0.  Local engine: materializes
-        through the driver (executor tasks still run the lineage);
-        production-scale data should be written with >= num_executors
-        shards instead."""
+        synchronized global-stop at step 0.
+
+        Local engine: MATERIALIZES the whole dataset through the driver
+        (executor tasks still run the lineage), so the byte volume is
+        measured and logged — a dataset that was too big per partition
+        will collapse driver memory here.  For TFRecord sources use
+        ``dfutil.load_tfrecords(..., min_partitions=N)`` instead: it
+        stripes the shard FILES across partitions with no driver
+        materialization.  Production-scale data should be written with
+        >= num_executors shards in the first place."""
         rows = self.collect()
         n = max(1, min(num_partitions, max(len(rows), 1)))
+        approx = _approx_bytes(rows)
+        msg = ("repartition(%d) materialized %d rows (~%s) through the "
+               "driver")
+        if approx > 256 * 1024 * 1024:
+            logger.warning(
+                msg + " — for TFRecords use load_tfrecords(..., "
+                "min_partitions=N) to stripe shards without driver "
+                "materialization", n, len(rows), _fmt_bytes(approx))
+        else:
+            logger.info(msg, n, len(rows), _fmt_bytes(approx))
         parts = [rows[i::n] for i in range(n)]
         return LocalDataset(self._engine, parts)
 

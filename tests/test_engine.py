@@ -127,6 +127,29 @@ def test_repartition_balances_and_preserves_rows():
         engine.stop()
 
 
+def test_repartition_logs_materialized_volume(caplog):
+    """The local-engine repartition materializes through the driver;
+    it must SAY so with the measured volume (VERDICT r3 weak #6)."""
+    import logging
+
+    import numpy as np
+
+    from tensorflowonspark_tpu.engine import LocalEngine, _approx_bytes
+
+    engine = LocalEngine(2)
+    try:
+        rows = [(np.zeros((8, 8), np.uint8), i) for i in range(10)]
+        with caplog.at_level(logging.INFO,
+                             logger="tensorflowonspark_tpu.engine"):
+            engine.parallelize(rows, 1).repartition(4)
+        assert any("materialized 10 rows" in r.message for r in caplog.records)
+    finally:
+        engine.stop()
+    # the estimator sees ndarray payloads, not container overhead only
+    est = _approx_bytes(rows)
+    assert est >= 10 * 64  # 10 rows x 64-byte arrays
+
+
 def test_spark_dataset_repartition_via_stub():
     import os
     import sys
