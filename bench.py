@@ -33,20 +33,38 @@ def _peak_flops(device):
     return 197e12  # default: v5e
 
 
-FED_CHUNK = 64  # records per shm-ring chunk (node.FEED_CHUNK_RECORDS scale)
+# records per shm-ring chunk (node.FEED_CHUNK_RECORDS scale); bigger
+# chunks amortize per-chunk python + copy overheads, smaller ones keep
+# ring latency low — sweep with scripts/stress_fed.py
+FED_CHUNK = int(os.environ.get("TFOS_FED_CHUNK", "64"))
 
 
-def _feeder_main(ring_name, mgr_addr, authkey_hex, total_records, image, pool=16):
+def _feeder_main(ring_name, mgr_addr, authkey_hex, total_records, image,
+                 pool=None):
     """Feeder child (no jax): generate (uint8 image, label) records and push
-    chunks through the shm ring exactly like node.train's feeder closure."""
+    chunks through the shm ring exactly like node.train's feeder closure —
+    including its columnar chunk encoder (n-D image fields go over the
+    wire as dense flattened columns; TFOS_BENCH_FED_COLUMNAR=0 reverts to
+    pickled row lists for A/B)."""
     import numpy as np
 
     from tensorflowonspark_tpu import manager as tfmanager
+    from tensorflowonspark_tpu import node as tfnode
     from tensorflowonspark_tpu.recordio import shm as shmq
 
+    if os.environ.get("TFOS_BENCH_FED_COLUMNAR", "1") != "0":
+        encode = tfnode._make_chunk_encoder()
+    else:
+        def encode(chunk):
+            return chunk
     mgr = tfmanager.connect(tuple(mgr_addr), bytes.fromhex(authkey_hex))
     ring = shmq.ShmQueue(ring_name, create=False, producer=True)
     rng = np.random.default_rng(0)
+    # pool MUST exceed the chunk size: with repeats inside one chunk,
+    # pickle memoizes the duplicate array references and the row-path
+    # wire volume collapses to pool-size unique images — flattering the
+    # row path by 4x in round-3 measurements
+    pool = pool or 2 * FED_CHUNK
     images = [rng.integers(0, 256, (image, image, 3), dtype=np.uint8)
               for _ in range(pool)]
     sent = 0
@@ -55,10 +73,10 @@ def _feeder_main(ring_name, mgr_addr, authkey_hex, total_records, image, pool=16
         chunk.append((images[sent % pool], sent % 1000))
         sent += 1
         if len(chunk) >= FED_CHUNK:
-            ring.put(chunk)
+            ring.put(encode(chunk))
             chunk = []
     if chunk:
-        ring.put(chunk)
+        ring.put(encode(chunk))
     ring.put(None)  # end-of-feed marker
     ring.close()
     mgr.set("feeder_done", 1)
@@ -82,8 +100,13 @@ def _fed_setup(batch, image, steps):
     mgr = tfmanager.start(authkey, ["input", "output", "error", "control"])
     ring_name = f"/tfos-bench-{os.getpid():x}"
     # modest capacity on purpose: a huge ring would let the feeder run
-    # steps ahead during compile and overstate steady-state throughput
-    ring = shmq.ShmQueue(ring_name, 64 << 20, create=True)
+    # steps ahead during compile and overstate steady-state throughput.
+    # Must hold several chunks or producer/consumer serialize — scale
+    # with TFOS_FED_CHUNK (env TFOS_FED_RING_MB overrides).
+    ring_mb = int(os.environ.get(
+        "TFOS_FED_RING_MB",
+        str(max(64, 6 * FED_CHUNK * image * image * 3 // (1 << 20)))))
+    ring = shmq.ShmQueue(ring_name, ring_mb << 20, create=True)
     mgr.set("shm_input", ring_name)
     total = (steps + 2) * batch  # +2 warmup batches
     ctx = mp.get_context("spawn")
@@ -186,15 +209,25 @@ def _fed_run(fed, step_fn, params, state, opt_state):
 
     threading.Thread(target=stall_watch, daemon=True).start()
 
-    def collate(cols):
-        return np.stack(cols["image"]), np.asarray(cols["label"], np.int32)
+    columnar = os.environ.get("TFOS_BENCH_FED_COLUMNAR", "1") != "0"
+    if columnar:
+        # dense-array pull: aligned chunks pass through zero-copy, the
+        # per-record python loop + np.stack (the 12k img/s wall, PERF.md)
+        # is gone from the consumer hot path
+        def collate(cols):
+            return cols["image"], np.asarray(cols["label"], np.int32)
+    else:
+        def collate(cols):
+            return np.stack(cols["image"]), np.asarray(cols["label"],
+                                                       np.int32)
 
     nsteps = 0
     n_timed = 0
     t0 = None
     wait_base = 0.0
     last = None
-    for imgs, labels in device_feed(feed, batch, collate=collate, depth=2):
+    for imgs, labels in device_feed(feed, batch, collate=collate, depth=2,
+                                    columnar=columnar):
         p, s, o, last, _ = fed_step(p, s, o, imgs, labels)
         nsteps += 1
         progress["n"] = nsteps
@@ -228,6 +261,7 @@ def _fed_run(fed, step_fn, params, state, opt_state):
         "infeed_wait_s": round(stall, 3),
         "infeed_stall_frac": round(stall / dt, 4) if dt else None,
         "steps": n_timed, "chunk_records": FED_CHUNK,
+        "columnar": columnar,
     }
     if progress["deadline_hit"]:
         out["deadline_hit"] = True  # truncated lane: numbers are partial
@@ -246,6 +280,15 @@ def _on_tpu_guess():
     return bool(plat) or tpu_info.count_chips() > 0
 
 
+def bench_config_path():
+    """THE bench_config.json location (TFOS_BENCH_CONFIG overrides the
+    repo-root default).  Single source of truth — the sweep scripts'
+    --promote writers and the session script's arg emitter all resolve
+    through here so producer and consumer can never drift apart."""
+    return os.environ.get("TFOS_BENCH_CONFIG") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_config.json")
+
+
 def _promoted_config():
     """Optional bench_config.json at the repo root: sweep winners
     applied to the TPU bench without code edits.  Top-level keys are the
@@ -253,8 +296,7 @@ def _promoted_config():
     sub-dict is the transformer sweep's winner
     (scripts/sweep_transformer.py --promote).  TFOS_BENCH_* env vars
     still win over promoted values."""
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "bench_config.json")
+    path = bench_config_path()
     if not os.path.exists(path):
         return {}
     try:

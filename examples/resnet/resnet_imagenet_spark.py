@@ -78,7 +78,10 @@ def main_fun(args, ctx):
     )
 
     def collate(cols):
-        # uint8 HWC records; normalization runs on device inside the step
+        # uint8 HWC records; normalization runs on device inside the
+        # step.  Under columnar pull cols are already dense arrays, so
+        # asarray + reshape are zero-copy views; with a row-path feeder
+        # the same code degrades to one stack/copy.
         imgs = np.asarray(cols["image"], dtype=np.uint8).reshape(
             -1, image, image, 3
         )
@@ -98,7 +101,7 @@ def main_fun(args, ctx):
     # feed even when ragged tails leave them different batch counts —
     # no stranded all-reduce, no reference-style "90% of steps" trick
     for imgs, labels in synchronized(device_feed(
-        feed, per_proc, collate=collate, depth=2,
+        feed, per_proc, collate=collate, depth=2, columnar=True,
         placement=lambda b: local_to_global(mesh, b),
     ), feed=feed):
         params, state, opt_state, loss, acc = step_fn(
@@ -134,6 +137,12 @@ def _records(args, engine):
         ds, schema = dfutil.load_tfrecords(
             engine, args.data_dir,
             binary_features=("image", "image/encoded"),
+            # stripe shard files across workers at the SOURCE: fewer
+            # shards than workers must not starve feeds (synchronized
+            # stop at step 0) nor fall into the record-level
+            # repartition below, which materializes every encoded image
+            # through the driver
+            min_partitions=args.cluster_size,
         )
         image = args.image_size
 
@@ -171,12 +180,12 @@ def _records(args, engine):
             return np.asarray(img, np.uint8), int(label)
 
         if ds.num_partitions < args.cluster_size:
-            # one partition feeds one worker; fewer shards than workers
-            # starves the rest and the synchronized stop ends training
-            # at step 0 — rebalance the ENCODED records (before decode,
-            # so the shuffle moves compact bytes, not decoded arrays;
-            # write >= cluster_size shards to avoid it entirely)
-            print(f"WARNING: {ds.num_partitions} data shard(s) for "
+            # min_partitions striping should prevent this; keep a
+            # belt-and-braces fallback for exotic sources.  Rebalances
+            # the ENCODED records (before decode) but materializes them
+            # through the driver on the local engine — load_tfrecords'
+            # striping is the production path.
+            print(f"WARNING: {ds.num_partitions} data partition(s) for "
                   f"{args.cluster_size} workers; repartitioning",
                   flush=True)
             ds = ds.repartition(args.cluster_size * 2)

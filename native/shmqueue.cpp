@@ -145,6 +145,83 @@ int shq_push(Queue* q, const uint8_t* buf, uint64_t len, int timeout_ms) {
   }
 }
 
+// Scatter-gather push: one reservation, each segment memcpy'd straight
+// from its source buffer (e.g. numpy column data) into the ring — no
+// python-side assembly of a contiguous message.  Same returns as
+// shq_push.
+int shq_push_iov(Queue* q, const uint8_t** bufs, const uint64_t* lens,
+                 int n, int timeout_ms) {
+  Header* h = q->h;
+  uint64_t len = 0;
+  for (int i = 0; i < n; i++) len += lens[i];
+  uint64_t need = align8(4 + len);
+  if (need + 8 > h->capacity) return -3;
+  int waited_us = 0;
+  for (;;) {
+    if (h->closed.load(std::memory_order_acquire)) return -2;
+    uint64_t head = h->head.load(std::memory_order_relaxed);
+    uint64_t tail = h->tail.load(std::memory_order_acquire);
+    if (head + need - tail <= h->capacity - 8) {
+      uint64_t off = head % h->capacity;
+      uint32_t len32 = (uint32_t)len;
+      memcpy(q->data + off, &len32, 4);
+      uint64_t poff = (off + 4) % h->capacity;
+      for (int i = 0; i < n; i++) {
+        uint64_t first = std::min(lens[i], h->capacity - poff);
+        memcpy(q->data + poff, bufs[i], first);
+        if (first < lens[i]) memcpy(q->data, bufs[i] + first, lens[i] - first);
+        poff = (poff + lens[i]) % h->capacity;
+      }
+      h->head.store(head + need, std::memory_order_release);
+      return 0;
+    }
+    if (timeout_ms >= 0 && waited_us / 1000 >= timeout_ms) return -1;
+    sleep_us(waited_us < 2000 ? 50 : 500);
+    waited_us += waited_us < 2000 ? 50 : 500;
+  }
+}
+
+// Wait for the next message and return its length WITHOUT consuming it
+// (-1 timeout, -2 EOF).  Pair with shq_pop_into to copy the payload
+// directly into a caller-owned buffer: one copy on the consumer side,
+// vs pop-to-scratch + a python-level copy.
+int64_t shq_peek_len(Queue* q, int timeout_ms) {
+  Header* h = q->h;
+  int waited_us = 0;
+  for (;;) {
+    uint64_t tail = h->tail.load(std::memory_order_relaxed);
+    uint64_t head = h->head.load(std::memory_order_acquire);
+    if (head != tail) {
+      uint32_t len32;
+      memcpy(&len32, q->data + (tail % h->capacity), 4);
+      return (int64_t)len32;
+    }
+    if (h->closed.load(std::memory_order_acquire)) return -2;
+    if (timeout_ms >= 0 && waited_us / 1000 >= timeout_ms) return -1;
+    sleep_us(waited_us < 2000 ? 50 : 500);
+    waited_us += waited_us < 2000 ? 50 : 500;
+  }
+}
+
+// Copy the pending message's payload into dst (size from shq_peek_len)
+// and consume it.  Returns the length, or -1 if no message is pending
+// (misuse: call only after a successful shq_peek_len).
+int64_t shq_pop_into(Queue* q, uint8_t* dst) {
+  Header* h = q->h;
+  uint64_t tail = h->tail.load(std::memory_order_relaxed);
+  uint64_t head = h->head.load(std::memory_order_acquire);
+  if (head == tail) return -1;
+  uint64_t off = tail % h->capacity;
+  uint32_t len32;
+  memcpy(&len32, q->data + off, 4);
+  uint64_t poff = (off + 4) % h->capacity;
+  uint64_t first = std::min((uint64_t)len32, h->capacity - poff);
+  memcpy(dst, q->data + poff, first);
+  if (first < len32) memcpy(dst + first, q->data, len32 - first);
+  h->tail.store(tail + align8(4 + len32), std::memory_order_release);
+  return (int64_t)len32;
+}
+
 // >=0: message length (0 = legitimately empty payload) copied into
 // internal scratch (get via shq_buffer); -1: timeout; -2: EOF (closed and
 // drained).

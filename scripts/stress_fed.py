@@ -1,0 +1,102 @@
+"""Fed-path CONSUMER stress bench (VERDICT r3 next #6c): real feeder
+process -> shm ring -> DataFeed, drained with NO device compute, so the
+number is the consumer-side ceiling (records/s) that bounds fed training
+throughput on a chip.
+
+Two modes, A/B-able in one run:
+  rows     — row-list chunks + next_batch + np.stack collate (the
+             round-2/3 hot path; PERF.md measured its np.stack wall at
+             ~12k img/s single-threaded at 224px)
+  columnar — ColumnChunk wire format (flattened uint8 image columns) +
+             next_batch_columns dense pull (round-4 fast path)
+
+Usage: python scripts/stress_fed.py [--batch 256] [--image 224]
+           [--steps 24] [--mode both|rows|columnar]
+Prints one JSON line per mode:
+  {"mode", "records_per_sec", "batches", "batch", "image"}
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_mode(mode, batch, image, steps):
+    import numpy as np
+
+    import bench
+    from tensorflowonspark_tpu.feed import DataFeed
+
+    os.environ["TFOS_BENCH_FED_COLUMNAR"] = (
+        "1" if mode == "columnar" else "0")
+    fed = bench._fed_setup(batch, image, steps)
+    if fed is None:
+        return {"mode": mode, "error": "shm unavailable"}
+    feed = DataFeed(fed["mgr"], train_mode=True,
+                    input_mapping={"image": "image", "label": "label"})
+    n_batches = 0
+    n_records = 0
+    t0 = None
+    dt = 0.0
+    try:
+        while not feed.should_stop():
+            if mode == "columnar":
+                cols = feed.next_batch_columns(batch)
+                imgs = cols["image"]
+                labels = np.asarray(cols["label"], np.int32)
+            else:
+                cols = feed.next_batch(batch)
+                if not cols["image"]:
+                    continue
+                imgs = np.stack(cols["image"])
+                labels = np.asarray(cols["label"], np.int32)
+            n = len(labels)
+            if n == 0:
+                continue
+            assert imgs.shape[1:] == (image, image, 3), imgs.shape
+            if t0 is None:  # skip the first batch (warmup/compile-free)
+                t0 = time.perf_counter()
+            else:
+                n_batches += 1
+                n_records += n
+        # stop the clock BEFORE teardown: proc.join/ring.close cost
+        # 100ms+ and would deflate short runs' records_per_sec
+        dt = time.perf_counter() - t0 if t0 is not None else 0.0
+    finally:
+        fed["proc"].join(timeout=10)
+        if fed["proc"].is_alive():
+            fed["proc"].kill()
+        fed["mgr"].set("state", "stopped")
+        fed["ring"].close()
+    rps = n_records / dt if dt > 0 else 0.0
+    return {"mode": mode, "records_per_sec": round(rps, 1),
+            "batches": n_batches, "batch": batch, "image": image}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--image", type=int, default=224)
+    ap.add_argument("--steps", type=int, default=24)
+    ap.add_argument("--mode", choices=("both", "rows", "columnar"),
+                    default="both")
+    args = ap.parse_args()
+    modes = (["rows", "columnar"] if args.mode == "both" else [args.mode])
+    results = []
+    for m in modes:
+        r = run_mode(m, args.batch, args.image, args.steps)
+        print(json.dumps(r), flush=True)
+        results.append(r)
+    if len(results) == 2 and all("records_per_sec" in r for r in results):
+        a, b = results[0]["records_per_sec"], results[1]["records_per_sec"]
+        if a:
+            print(json.dumps({"columnar_speedup": round(b / a, 2)}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -23,17 +23,24 @@ logger = logging.getLogger(__name__)
 _END = object()
 
 
-def batch_iterator(feed, batch_size, collate=None, min_batch=None):
+def batch_iterator(feed, batch_size, collate=None, min_batch=None,
+                   columnar=False):
     """DataFeed -> iterator of collated host batches.
 
     ``collate(records) -> pytree of np arrays`` (default: identity);
     short tails below ``min_batch`` (default: batch_size) are dropped,
     matching the examples' skip-short-batch convention so SPMD steps
     always see full shapes (no recompilation, no ragged collectives).
+
+    ``columnar=True`` pulls via ``feed.next_batch_columns`` — collate
+    receives ``{tensor: dense ndarray[n, ...]}`` instead of per-tensor
+    python lists, skipping the per-record loop + np.stack on the
+    consumer hot path (requires the feed's input_mapping).
     """
     min_batch = batch_size if min_batch is None else min_batch
+    pull = feed.next_batch_columns if columnar else feed.next_batch
     while not feed.should_stop():
-        records = feed.next_batch(batch_size)
+        records = pull(batch_size)
         n = len(next(iter(records.values()))) if isinstance(records, dict) \
             else len(records)
         if n < min_batch:
@@ -229,7 +236,7 @@ def tfrecord_device_feed(source, batch_size, *, collate=None, depth=2,
 
 
 def device_feed(feed, batch_size, *, collate=None, depth=2, placement=None,
-                min_batch=None):
+                min_batch=None, columnar=False):
     """The composed fast path: DataFeed -> collate -> double-buffered
     device staging.  Drop-in for the examples' while-loop:
 
@@ -237,9 +244,12 @@ def device_feed(feed, batch_size, *, collate=None, depth=2, placement=None,
                                  collate=my_collate,
                                  placement=lambda b: local_to_global(mesh, b)):
             params, ... = step_fn(params, ..., *batch)
+
+    ``columnar=True``: collate sees dense per-tensor arrays (see
+    ``batch_iterator``) — the preferred consumer for columnar feeds.
     """
     return prefetch_to_device(
-        batch_iterator(feed, batch_size, collate, min_batch),
+        batch_iterator(feed, batch_size, collate, min_batch, columnar),
         depth=depth,
         placement=placement,
         # abandoning the stream (early break / close) poisons the feed so

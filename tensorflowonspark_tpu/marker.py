@@ -31,11 +31,17 @@ class ColumnChunk:
     (TFSparkNode.py:480-482).
     """
 
-    __slots__ = ("spec", "columns")
+    __slots__ = ("spec", "columns", "shapes")
 
-    def __init__(self, spec, columns):
+    def __init__(self, spec, columns, shapes=None):
         self.spec = spec          # [(dtype_code, width), ...]
         self.columns = columns    # tuple of np.ndarray, one per field
+        # per-field original trailing shape for n-D tensor fields the
+        # feeder flattened to 1-D (images: (H, W, C) stored as a width
+        # H*W*C column), or None per field / None overall when every
+        # field was scalar/1-D already.  Consumers reshape VIEWS — the
+        # flatten/unflatten round-trip copies nothing.
+        self.shapes = shapes
 
     def __len__(self):
         return len(self.columns[0]) if self.columns else 0

@@ -25,7 +25,24 @@ _ext = None
 _ext_tried = False
 
 _CODE_TO_DTYPE = {"?": np.bool_, "i": np.int32, "l": np.int64,
-                  "f": np.float32, "d": np.float64}
+                  "f": np.float32, "d": np.float64,
+                  # narrow integer columns (image bytes!): keep the
+                  # native dtype on the wire instead of upcasting to
+                  # int32 — a 224x224x3 uint8 image must travel as 147KB,
+                  # not 588KB
+                  "b": np.int8, "B": np.uint8,
+                  "h": np.int16, "H": np.uint16}
+
+# codes the C extension's per-element fill loop understands; narrow
+# codes deliberately stay on the numpy path — their columns come from
+# ndarray rows where one bulk np.asarray copy beats per-element boxing
+_EXT_CODES = "?ilfd"
+
+# dtypes the C reconstruction loop (columns_to_rows) can read back —
+# exactly the buffer formats its format_code/value_from switch handles
+_EXT_OUT_DTYPES = frozenset(
+    np.dtype(t) for t in (np.bool_, np.int8, np.int32, np.int64,
+                          np.float32, np.float64))
 
 
 def _load_ext():
@@ -55,16 +72,19 @@ def native_available():
 
 
 def _ndarray_code(dtype):
-    """Spec code for a numpy dtype (lossless widening only: int8 'b' must
-    NOT collide with bool '?', uint64 does not fit int64)."""
+    """Spec code for a numpy dtype (exact-width for narrow ints so image
+    bytes never upcast on the wire; int8 'b' must NOT collide with bool
+    '?', uint64 does not fit int64)."""
     if dtype.kind == "b":
         return "?"
     if dtype.kind == "i":
-        return "i" if dtype.itemsize <= 4 else "l"
+        return {1: "b", 2: "h", 4: "i"}.get(dtype.itemsize, "l")
     if dtype.kind == "u":
         if dtype.itemsize >= 8:
             raise ValueError("uint64 columns do not fit the int64 spec")
-        return "i" if dtype.itemsize <= 2 else "l"
+        # unsigned widths widen one step only where exactness demands it:
+        # uint8 'B' / uint16 'H' are exact; uint32 needs int64
+        return {1: "B", 2: "H"}.get(dtype.itemsize, "l")
     if dtype.kind == "f":
         return "f" if dtype.itemsize <= 4 else "d"
     raise ValueError(f"unsupported ndarray dtype {dtype}")
@@ -139,7 +159,7 @@ def rows_to_columns(rows, spec=None):
     if spec is None:
         spec = infer_spec(rows[0])
     ext = _load_ext()
-    if ext is not None and all(c in _CODE_TO_DTYPE for c, _ in spec):
+    if ext is not None and all(c in _EXT_CODES for c, _ in spec):
         return ext.rows_to_columns(rows, [(c, int(w)) for c, w in spec])
     # numpy fallback (identical semantics)
     for i, r in enumerate(rows):
@@ -154,7 +174,7 @@ def rows_to_columns(rows, spec=None):
             arr = np.empty(len(vals), dtype=object)
             arr[:] = vals
         else:
-            if code in "?il":
+            if code in "?ilbBhH":
                 # a spec inferred from an int first row must not silently
                 # truncate floats that appear in later rows — reject the
                 # lossy cast so callers fall back to the exact row path
@@ -166,13 +186,17 @@ def rows_to_columns(rows, spec=None):
                         f"column {c}: {natural.dtype} values under spec "
                         f"{code!r} (lossy cast refused)"
                     )
-                if code == "i" and natural.dtype.itemsize > 4:
-                    info = np.iinfo(np.int32)
+                target = _CODE_TO_DTYPE[code]
+                if code != "?" and natural.dtype != np.dtype(target):
+                    # narrowing (or sign-crossing) casts are checked by
+                    # VALUE range, like the C fill loop's int32 guard
+                    info = np.iinfo(target)
                     if (natural > info.max).any() or (natural < info.min).any():
                         raise ValueError(
-                            f"column {c}: values overflow the int32 spec"
+                            f"column {c}: values overflow the "
+                            f"{np.dtype(target).name} spec"
                         )
-                arr = natural.astype(_CODE_TO_DTYPE[code], copy=False)
+                arr = natural.astype(target, copy=False)
             else:
                 arr = np.asarray(vals, dtype=_CODE_TO_DTYPE[code])
             if width and arr.shape[1:] != (width,):
@@ -192,7 +216,7 @@ def columns_to_rows(columns):
     columns = [np.ascontiguousarray(a) for a in columns]
     ext = _load_ext()
     if ext is not None and all(
-        a.dtype.kind in "bif?" and a.ndim in (1, 2) for a in columns
+        a.dtype in _EXT_OUT_DTYPES and a.ndim in (1, 2) for a in columns
     ):
         return ext.columns_to_rows(columns)
     n = len(columns[0]) if columns else 0

@@ -125,6 +125,19 @@ def _bind(lib):
     lib.shq_push.argtypes = [c.c_void_p, c.c_char_p, c.c_uint64, c.c_int]
     lib.shq_pop.restype = c.c_int64
     lib.shq_pop.argtypes = [c.c_void_p, c.c_int]
+    try:
+        lib.shq_push_iov.restype = c.c_int
+        lib.shq_push_iov.argtypes = [c.c_void_p, c.POINTER(c.c_void_p),
+                                     c.POINTER(c.c_uint64), c.c_int, c.c_int]
+        lib.shq_peek_len.restype = c.c_int64
+        lib.shq_peek_len.argtypes = [c.c_void_p, c.c_int]
+        lib.shq_pop_into.restype = c.c_int64
+        lib.shq_pop_into.argtypes = [c.c_void_p, c.c_void_p]
+        lib.tfos_has_iov = True
+    except AttributeError:
+        # pre-round-4 .so without the scatter-gather entry points: the
+        # queue layer checks tfos_has_iov and stays on the classic path
+        lib.tfos_has_iov = False
     lib.shq_buffer.restype = u8p
     lib.shq_buffer.argtypes = [c.c_void_p]
     lib.shq_close_write.argtypes = [c.c_void_p]

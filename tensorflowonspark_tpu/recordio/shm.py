@@ -15,6 +15,34 @@ import pickle
 
 from tensorflowonspark_tpu.recordio import native as _native
 
+# fast-path frame magic: cannot collide with a pickle stream (protocol 2+
+# starts with b'\x80'), so legacy and columnar messages share one ring
+_COLMAGIC = b"TFC\x01"
+
+
+def _decode_columnar(buf):
+    """Rebuild a ColumnChunk from a fast-path frame: columns are numpy
+    VIEWS over ``buf`` (a bytearray owned by the returned arrays via
+    .base) — zero further copies."""
+    import numpy as np
+
+    from tensorflowonspark_tpu import marker as _marker
+
+    hlen = int.from_bytes(bytes(buf[4:8]), "little")
+    spec, shapes, descrs = pickle.loads(bytes(buf[8:8 + hlen]))
+    off = 8 + hlen
+    cols = []
+    mv = memoryview(buf)
+    for dtype_str, shape in descrs:
+        dt = np.dtype(dtype_str)
+        count = 1
+        for s in shape:
+            count *= s
+        a = np.frombuffer(mv, dtype=dt, count=count, offset=off)
+        cols.append(a.reshape(shape))
+        off += a.nbytes
+    return _marker.ColumnChunk(spec, tuple(cols), shapes=shapes)
+
 
 def _lock_path(name):
     import tempfile
@@ -97,12 +125,98 @@ class ShmQueue:
         return ctypes.string_at(self._lib.shq_buffer(self._h), n) if n else b""
 
     def put(self, obj, timeout_ms=-1):
-        self.put_bytes(pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
-                       timeout_ms)
+        """Push one object.  ColumnChunks with contiguous numeric columns
+        take a scatter-gather fast path: a small pickled header plus the
+        raw column bytes memcpy'd straight from the numpy buffers into
+        the ring — ONE payload copy on the producer side, vs pickling the
+        arrays into an intermediate bytes first.  Everything else (row
+        lists, markers, None) rides classic pickle."""
+        fast = self._put_columnar(obj, timeout_ms)
+        if not fast:
+            self.put_bytes(
+                pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
+                timeout_ms)
 
     def get(self, timeout_ms=-1):
+        """Pop one object.  Fast-path messages are popped directly into a
+        caller-owned buffer (one copy) and the columns come back as numpy
+        VIEWS over it — no pickle, no further copies."""
+        if getattr(self._lib, "tfos_has_iov", False):
+            import numpy as np
+
+            n = self._lib.shq_peek_len(self._h, timeout_ms)
+            if n == -1:
+                raise TimeoutError(f"shm queue {self.name} empty")
+            if n == -2:
+                return None  # closed and drained
+            # np.empty, NOT bytearray: bytearray(n) zero-fills, which is
+            # a full hidden extra write of the payload size per message
+            buf = np.empty(n, np.uint8)
+            if n:
+                got = self._lib.shq_pop_into(
+                    self._h, ctypes.c_void_p(buf.ctypes.data))
+            else:
+                got = self._lib.shq_pop_into(self._h, None)
+            if got != n:  # single-consumer contract violated
+                raise RuntimeError(
+                    f"shm queue {self.name}: peeked {n} bytes but popped "
+                    f"{got} (concurrent consumer?)")
+            if n >= 4 and bytes(buf[:4]) == _COLMAGIC:
+                return _decode_columnar(buf)
+            # loads() takes any bytes-like: no tobytes() copy of the
+            # whole payload just to unpickle a legacy message
+            return pickle.loads(memoryview(buf) if n else b"")
         data = self.get_bytes(timeout_ms)
-        return None if data is None else pickle.loads(data)
+        if data is None:
+            return None
+        if data[:4] == _COLMAGIC:
+            return _decode_columnar(bytearray(data))
+        return pickle.loads(data)
+
+    def _put_columnar(self, obj, timeout_ms):
+        """Scatter-gather push of a ColumnChunk; False when not eligible
+        (no iov support, non-chunk payload, object/non-contiguous
+        columns) so put() falls back to pickle."""
+        if not getattr(self._lib, "tfos_has_iov", False):
+            return False
+        from tensorflowonspark_tpu import marker as _marker
+
+        if not isinstance(obj, _marker.ColumnChunk):
+            return False
+        import numpy as np
+
+        cols = obj.columns
+        if not cols or any(
+            not isinstance(a, np.ndarray) or a.dtype.hasobject
+            or not a.flags.c_contiguous
+            for a in cols
+        ):
+            return False
+        header = pickle.dumps(
+            (obj.spec, getattr(obj, "shapes", None),
+             [(a.dtype.str, a.shape) for a in cols]),
+            protocol=pickle.HIGHEST_PROTOCOL)
+        segs = [_COLMAGIC, len(header).to_bytes(4, "little"), header]
+        n = len(segs) + len(cols)
+        bufs = (ctypes.c_void_p * n)()
+        lens = (ctypes.c_uint64 * n)()
+        keepalive = []
+        for i, s in enumerate(segs):
+            b = ctypes.create_string_buffer(s, len(s))
+            keepalive.append(b)
+            bufs[i] = ctypes.addressof(b)
+            lens[i] = len(s)
+        for j, a in enumerate(cols):
+            bufs[len(segs) + j] = a.ctypes.data
+            lens[len(segs) + j] = a.nbytes
+        rc = self._lib.shq_push_iov(self._h, bufs, lens, n, timeout_ms)
+        if rc == -1:
+            raise TimeoutError(f"shm queue {self.name} full")
+        if rc == -2:
+            raise BrokenPipeError(f"shm queue {self.name} closed")
+        if rc == -3:
+            raise ValueError("message larger than ring capacity")
+        return True
 
     def close_write(self):
         self._lib.shq_close_write(self._h)

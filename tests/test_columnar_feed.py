@@ -98,6 +98,109 @@ def test_datafeed_columnar_no_mapping_roundtrip(mgr):
         assert got[1] == want[1]
 
 
+IMG_ROWS = [(np.full((4, 6, 3), i, np.uint8), i % 10) for i in range(64)]
+
+
+def test_encoder_flattens_nd_image_fields():
+    """n-D ndarray fields (images) go columnar as flattened width columns
+    with the original shape carried in ColumnChunk.shapes — the wire
+    format for the fed hot path (PERF.md 12k img/s np.stack wall)."""
+    enc = node._make_chunk_encoder()
+    chunk = enc(list(IMG_ROWS[:32]))
+    assert isinstance(chunk, marker.ColumnChunk)
+    assert chunk.shapes == ((4, 6, 3), None)
+    assert chunk.spec[0] == ("B", 4 * 6 * 3)
+    assert chunk.columns[0].shape == (32, 72)
+    np.testing.assert_array_equal(
+        chunk.columns[0][5].reshape(4, 6, 3), IMG_ROWS[5][0])
+
+
+def test_encoder_nd_shape_drift_falls_back_to_rows():
+    enc = node._make_chunk_encoder()
+    assert isinstance(enc(list(IMG_ROWS[:8])), marker.ColumnChunk)
+    drift = [(np.zeros((6, 4, 3), np.uint8), 1)] * 4  # transposed shape
+    out = enc(drift)
+    assert out is drift  # row path, not a silently mis-shaped column
+
+
+def test_datafeed_nd_columnar_row_consumers_see_original_shape(mgr):
+    enc = node._make_chunk_encoder()
+    _feed_chunks(mgr, [enc(list(IMG_ROWS[:40])), enc(list(IMG_ROWS[40:]))])
+    feed = DataFeed(mgr, train_mode=True,
+                    input_mapping={"image": "image", "label": "label"})
+    got_imgs, got_labels = [], []
+    for b in _drain_batches(feed, 16):
+        for v in b["image"]:
+            assert v.shape == (4, 6, 3)
+            got_imgs.append(v)
+        got_labels.extend(int(v) for v in b["label"])
+    np.testing.assert_array_equal(
+        np.stack(got_imgs), np.stack([r[0] for r in IMG_ROWS]))
+    assert got_labels == [r[1] for r in IMG_ROWS]
+
+
+def test_datafeed_nd_columnar_no_mapping_roundtrip(mgr):
+    enc = node._make_chunk_encoder()
+    _feed_chunks(mgr, [enc(list(IMG_ROWS))])
+    feed = DataFeed(mgr, train_mode=True)
+    records = []
+    while not feed.should_stop():
+        records.extend(feed.next_batch(24))
+    assert len(records) == len(IMG_ROWS)
+    for got, want in zip(records, IMG_ROWS):
+        assert got[0].shape == (4, 6, 3)
+        np.testing.assert_array_equal(got[0], want[0])
+        assert got[1] == want[1]
+
+
+def test_next_batch_columns_dense_and_zero_copy(mgr):
+    """Aligned chunk -> zero-copy dense batch; spanning chunks -> one
+    concatenate; short tail returned as-is."""
+    enc = node._make_chunk_encoder()
+    chunks = [enc(list(IMG_ROWS[:32])), enc(list(IMG_ROWS[32:56])),
+              enc(list(IMG_ROWS[56:]))]
+    _feed_chunks(mgr, chunks)
+    feed = DataFeed(mgr, train_mode=True,
+                    input_mapping={"image": "image", "label": "label"})
+
+    b1 = feed.next_batch_columns(32)  # exactly chunk 1: zero copy
+    assert b1["image"].shape == (32, 4, 6, 3)
+    assert b1["image"].dtype == np.uint8  # narrow wire dtype preserved
+    # a VIEW of the received chunk's column (reshape of a slice), not a
+    # freshly stacked copy (the queue itself pickles, so identity with
+    # the producer-side array is out of scope)
+    assert b1["image"].base is not None
+    np.testing.assert_array_equal(
+        b1["image"], np.stack([r[0] for r in IMG_ROWS[:32]]))
+
+    b2 = feed.next_batch_columns(32)  # spans chunks 2+3: one concat
+    assert b2["image"].shape == (32, 4, 6, 3)
+    np.testing.assert_array_equal(
+        b2["image"], np.stack([r[0] for r in IMG_ROWS[32:]]))
+    assert list(b2["label"]) == [r[1] for r in IMG_ROWS[32:]]
+
+    tail = feed.next_batch_columns(32)  # end of feed: empty
+    assert feed.should_stop() and len(tail["image"]) == 0
+
+
+def test_next_batch_columns_row_chunk_fallback(mgr):
+    """Non-columnar feeders (plain row lists) still work through the
+    dense consumer, via per-segment np.stack."""
+    _feed_chunks(mgr, [list(IMG_ROWS[:20]), list(IMG_ROWS[20:48])])
+    feed = DataFeed(mgr, train_mode=True,
+                    input_mapping={"image": "image", "label": "label"})
+    b = feed.next_batch_columns(48)
+    assert b["image"].shape == (48, 4, 6, 3)
+    np.testing.assert_array_equal(
+        b["image"], np.stack([r[0] for r in IMG_ROWS[:48]]))
+
+
+def test_next_batch_columns_requires_mapping(mgr):
+    feed = DataFeed(mgr, train_mode=True)
+    with pytest.raises(ValueError, match="input_mapping"):
+        feed.next_batch_columns(8)
+
+
 def test_datafeed_mixed_row_and_columnar_chunks(mgr):
     enc = node._make_chunk_encoder()
     _feed_chunks(mgr, [ROWS[:30], enc(ROWS[30:60]), ROWS[60:]])

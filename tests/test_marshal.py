@@ -117,13 +117,32 @@ def test_int32_spec_overflow_refused(impl):
 
 def test_infer_spec_int8_is_not_bool():
     """numpy's int8 char 'b' must not collide with the bool code '?'
-    ([5,0,2] silently became [True,False,True] before round 3)."""
+    ([5,0,2] silently became [True,False,True] before round 3); since
+    round 4 narrow ints keep their exact width on the wire ('b')."""
     spec = marshal.infer_spec((np.array([5, 0, 2], np.int8),))
-    assert spec == [("i", 3)]
+    assert spec == [("b", 3)]
     cols = marshal.rows_to_columns(
         [(np.array([5, 0, 2], np.int8),)], spec
     )
+    assert cols[0].dtype == np.int8
     assert cols[0].tolist() == [[5, 0, 2]]
+
+
+def test_narrow_uint8_column_roundtrip():
+    """Image bytes must not upcast on the wire: uint8 rows -> 'B' spec ->
+    uint8 dense column -> exact scalars back (values 0..255)."""
+    rows = [(np.array([0, 127, 255], np.uint8), i) for i in range(4)]
+    spec = marshal.infer_spec(rows[0])
+    assert spec[0] == ("B", 3)
+    cols = marshal.rows_to_columns(rows, spec)
+    assert cols[0].dtype == np.uint8 and cols[0].shape == (4, 3)
+    back = marshal.columns_to_rows(cols)
+    assert back[0][0] == [0, 127, 255]
+    # overflow into a narrow spec is refused by value, like int32
+    with pytest.raises(ValueError, match="overflow"):
+        marshal.rows_to_columns(
+            [(np.array([5], np.int64),)] + [(np.array([300], np.int64),)],
+            [("B", 1)])
 
 
 def test_infer_spec_rejects_uint64_and_multidim():
