@@ -45,6 +45,11 @@ run python scripts/profile_resnet.py --out "${TFOS_SESSION_BREAKDOWN:-PERF_BREAK
     $(python scripts/promoted_profile_args.py) \
     $profile_extra
 run python scripts/sweep_transformer.py --steps "${TFOS_SESSION_TRANSFORMER_STEPS:-8}" --promote
+# host-side fed-consumer ceiling (no TPU claim: feeder+DataFeed only) —
+# the number that bounds fed training throughput on THIS host
+if [ "${TFOS_SESSION_STRESS:-1}" = "1" ] && [ "${TFOS_SESSION_SMOKE:-0}" != "1" ]; then
+  run python scripts/stress_fed.py --batch 256 --image 224 --steps 24
+fi
 if [ "${TFOS_SESSION_SMOKE:-0}" = "1" ]; then
   echo "-- bench.py skipped (smoke mode) --" | tee -a "$log"
 else

@@ -219,10 +219,14 @@ class DataFeed:
                         # match columns_to_rows exactly: PYTHON scalars
                         # for 1-D columns, python lists for width
                         # columns (tolist, not list: list() would keep
-                        # numpy scalar elements); shaped fields keep
-                        # their original ndarray form (reshape views)
+                        # numpy scalar elements).  Shaped fields COPY:
+                        # records from this path are independent objects
+                        # a consumer may retain, and a view would pin
+                        # the whole multi-MB chunk buffer per record
+                        # (the mapping/columns paths keep views — their
+                        # consumers collate immediately)
                         if shapes[i] is not None:
-                            return c[j]
+                            return c[j].copy()
                         return c[j].item() if c.ndim == 1 else c[j].tolist()
 
                     self._buffer.extend(

@@ -20,17 +20,22 @@ from tensorflowonspark_tpu.recordio import native as _native
 _COLMAGIC = b"TFC\x01"
 
 
+def _align8(n):
+    return (n + 7) & ~7
+
+
 def _decode_columnar(buf):
     """Rebuild a ColumnChunk from a fast-path frame: columns are numpy
-    VIEWS over ``buf`` (a bytearray owned by the returned arrays via
-    .base) — zero further copies."""
+    VIEWS over ``buf`` (owned by the returned arrays via .base) — zero
+    further copies.  Every column starts 8-byte aligned (the producer
+    pads), so int64/float64 views never take numpy's unaligned paths."""
     import numpy as np
 
     from tensorflowonspark_tpu import marker as _marker
 
     hlen = int.from_bytes(bytes(buf[4:8]), "little")
     spec, shapes, descrs = pickle.loads(bytes(buf[8:8 + hlen]))
-    off = 8 + hlen
+    off = _align8(8 + hlen)
     cols = []
     mv = memoryview(buf)
     for dtype_str, shape in descrs:
@@ -40,7 +45,7 @@ def _decode_columnar(buf):
             count *= s
         a = np.frombuffer(mv, dtype=dt, count=count, offset=off)
         cols.append(a.reshape(shape))
-        off += a.nbytes
+        off = _align8(off + a.nbytes)
     return _marker.ColumnChunk(spec, tuple(cols), shapes=shapes)
 
 
@@ -196,19 +201,38 @@ class ShmQueue:
             (obj.spec, getattr(obj, "shapes", None),
              [(a.dtype.str, a.shape) for a in cols]),
             protocol=pickle.HIGHEST_PROTOCOL)
-        segs = [_COLMAGIC, len(header).to_bytes(4, "little"), header]
-        n = len(segs) + len(cols)
+        # pad so every column lands 8-byte aligned in the frame (the
+        # consumer views them in place; unaligned int64/float64 views
+        # would take numpy's slow paths on every message)
+        pad8 = b"\0" * 8
+        segs = [(_COLMAGIC, len(_COLMAGIC)),
+                (len(header).to_bytes(4, "little"), 4),
+                (header, len(header))]
+        off = 8 + len(header)
+        if off % 8:
+            segs.append((pad8, 8 - off % 8))
+        col_segs = []
+        for a in cols:
+            col_segs.append((a, a.nbytes))
+            if a.nbytes % 8:
+                col_segs.append((pad8, 8 - a.nbytes % 8))
+        n = len(segs) + len(col_segs)
         bufs = (ctypes.c_void_p * n)()
         lens = (ctypes.c_uint64 * n)()
         keepalive = []
-        for i, s in enumerate(segs):
+        for i, (s, ln) in enumerate(segs):
             b = ctypes.create_string_buffer(s, len(s))
             keepalive.append(b)
             bufs[i] = ctypes.addressof(b)
-            lens[i] = len(s)
-        for j, a in enumerate(cols):
-            bufs[len(segs) + j] = a.ctypes.data
-            lens[len(segs) + j] = a.nbytes
+            lens[i] = ln
+        pad_buf = ctypes.create_string_buffer(pad8, 8)
+        for j, (a, ln) in enumerate(col_segs):
+            if a is pad8:
+                bufs[len(segs) + j] = ctypes.addressof(pad_buf)
+            else:
+                bufs[len(segs) + j] = a.ctypes.data
+                keepalive.append(a)
+            lens[len(segs) + j] = ln
         rc = self._lib.shq_push_iov(self._h, bufs, lens, n, timeout_ms)
         if rc == -1:
             raise TimeoutError(f"shm queue {self.name} full")

@@ -80,6 +80,115 @@ def test_cross_process_stream():
         q.close()
 
 
+def _make_chunk(n=24, hw=6):
+    import numpy as np
+
+    from tensorflowonspark_tpu import node as tfnode
+
+    rng = np.random.default_rng(0)
+    rows = [(rng.integers(0, 256, (hw, hw, 3), dtype=np.uint8), int(i))
+            for i in range(n)]
+    enc = tfnode._make_chunk_encoder()
+    chunk = enc(rows)
+    from tensorflowonspark_tpu import marker
+
+    assert isinstance(chunk, marker.ColumnChunk)  # precondition
+    return rows, chunk
+
+
+def test_columnar_fast_path_roundtrip():
+    """The round-4 scatter-gather wire (put -> shq_push_iov -> TFC frame
+    -> shq_peek_len/shq_pop_into -> _decode_columnar): exact bytes back,
+    shapes metadata intact, every column 8-byte ALIGNED (views over the
+    popped buffer must not hit numpy's unaligned paths), and legacy
+    pickled messages coexist on the same ring."""
+    import numpy as np
+
+    from tensorflowonspark_tpu import marker
+
+    q = shm.ShmQueue(f"/tfosq-test-{os.getpid()}-f", capacity=1 << 22,
+                     create=True)
+    try:
+        rows, chunk = _make_chunk()
+        q.put(chunk)
+        q.put(["legacy", ("row", 1)])     # classic pickle, same ring
+        q.put(marker.EndPartition())
+        q.put(None)
+
+        got = q.get(timeout_ms=5000)
+        assert isinstance(got, marker.ColumnChunk)
+        assert got.spec == chunk.spec and got.shapes == chunk.shapes
+        for a, b in zip(got.columns, chunk.columns):
+            assert a.dtype == b.dtype
+            np.testing.assert_array_equal(a, b)
+            assert a.ctypes.data % 8 == 0, "column view not 8-byte aligned"
+        # views share one buffer (zero-copy decode), not fresh copies
+        assert got.columns[0].base is not None
+
+        assert q.get(timeout_ms=5000) == ["legacy", ("row", 1)]
+        assert isinstance(q.get(timeout_ms=5000), marker.EndPartition)
+        assert q.get(timeout_ms=5000) is None  # classic-pickle None
+    finally:
+        q.close()
+
+
+def test_columnar_fast_path_wraparound_stream():
+    """Many columnar frames through a ring smaller than the total volume
+    (wrap-around inside the iov push) — every frame decodes exactly."""
+    import numpy as np
+
+    q = shm.ShmQueue(f"/tfosq-test-{os.getpid()}-g", capacity=1 << 16,
+                     create=True)
+    try:
+        _, chunk = _make_chunk(n=12, hw=4)
+        for i in range(200):
+            q.put(chunk, timeout_ms=2000)
+            got = q.get(timeout_ms=2000)
+            for a, b in zip(got.columns, chunk.columns):
+                np.testing.assert_array_equal(a, b)
+    finally:
+        q.close()
+
+
+def _columnar_producer(name, n):
+    from tests.test_shmqueue import _make_chunk
+
+    q = shm.ShmQueue(name, create=False, producer=True)
+    _, chunk = _make_chunk(n=16, hw=5)
+    for _ in range(n):
+        q.put(chunk, timeout_ms=30000)
+    q.put(None)
+    q.close_write()
+    q.close()
+
+
+def test_columnar_cross_process_stream():
+    """Producer process pushes ColumnChunks via the iov fast path; this
+    process decodes them — the exact transport the fed bench lane uses."""
+    import numpy as np
+
+    name = f"/tfosq-test-{os.getpid()}-h"
+    q = shm.ShmQueue(name, capacity=1 << 20, create=True)
+    try:
+        ctx = mp.get_context("spawn")
+        p = ctx.Process(target=_columnar_producer, args=(name, 50))
+        p.start()
+        _, want = _make_chunk(n=16, hw=5)
+        got_n = 0
+        while True:
+            item = q.get(timeout_ms=30000)
+            if item is None:
+                break
+            for a, b in zip(item.columns, want.columns):
+                np.testing.assert_array_equal(a, b)
+            got_n += 1
+        assert got_n == 50
+        p.join(10)
+        assert p.exitcode == 0
+    finally:
+        q.close()
+
+
 def test_throughput_smoke():
     """The ring should clear 100 MB/s same-process (sanity, not a
     bench — real hardware does GB/s).  Best-of-3: a single scheduler
