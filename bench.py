@@ -502,6 +502,7 @@ def _transformer_bench(dev, on_tpu):
         )
         batch, steps = 2, 3
     remat = bool(promoted.get("remat", False))
+    ce_impl = ("blockwise" if promoted.get("ce") == "block" else "dense")
     attn_fn = None
     if promoted.get("block_q") or promoted.get("block_kv"):
         import functools
@@ -533,7 +534,8 @@ def _transformer_bench(dev, on_tpu):
         def body(carry, _):
             p, o = carry
             loss, grads = jax.value_and_grad(transformer.loss_fn)(
-                p, tokens, cfg, attn_fn=attn_fn, remat=remat
+                p, tokens, cfg, attn_fn=attn_fn, remat=remat,
+                ce_impl=ce_impl, ce_block=min(2048, cfg.vocab_size),
             )
             updates, o = opt.update(grads, o)
             return (optax.apply_updates(p, updates), o), loss
@@ -552,6 +554,8 @@ def _transformer_bench(dev, on_tpu):
     }
     if remat:
         out["remat"] = True
+    if ce_impl != "dense":
+        out["ce"] = "block"  # same spelling as the promoted config
     if promoted:
         out["promoted"] = {k: promoted[k] for k in sorted(promoted)}
     return out

@@ -16,19 +16,24 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
 
-# (name, batch, block_q, block_kv, remat, bwd) — module-level so dry-run
-# tests can substitute tiny shapes while driving the REAL promote paths.
+# (name, batch, block_q, block_kv, remat, bwd, ce) — module-level so
+# dry-run tests can substitute tiny shapes while driving the REAL
+# promote paths.  ce: "dense" | "block" (blockwise streamed CE — no
+# [B,S,V] logits tensor, buys batch headroom without full remat).
 CONFIGS = [
-    ("b16_q512_kv512", 16, 512, 512, False, "xla"),
-    ("b16_q512_kv512_pbwd", 16, 512, 512, False, "pallas"),
-    ("b8_q512_kv512", 8, 512, 512, False, "xla"),
-    ("b16_q1024_kv512", 16, 1024, 512, False, "xla"),
-    ("b16_q512_kv1024", 16, 512, 1024, False, "xla"),
-    ("b16_q1024_kv1024", 16, 1024, 1024, False, "xla"),
-    ("b32_q512_kv512", 32, 512, 512, False, "xla"),
-    ("b32_q512_kv512_remat", 32, 512, 512, True, "xla"),
-    ("b32_q512_kv512_remat_pbwd", 32, 512, 512, True, "pallas"),
-    ("b64_q512_kv512_remat", 64, 512, 512, True, "xla"),
+    ("b16_q512_kv512", 16, 512, 512, False, "xla", "dense"),
+    ("b16_q512_kv512_pbwd", 16, 512, 512, False, "pallas", "dense"),
+    ("b8_q512_kv512", 8, 512, 512, False, "xla", "dense"),
+    ("b16_q1024_kv512", 16, 1024, 512, False, "xla", "dense"),
+    ("b16_q512_kv1024", 16, 512, 1024, False, "xla", "dense"),
+    ("b16_q1024_kv1024", 16, 1024, 1024, False, "xla", "dense"),
+    ("b32_q512_kv512", 32, 512, 512, False, "xla", "dense"),
+    ("b32_q512_kv512_bce", 32, 512, 512, False, "xla", "block"),
+    ("b32_q512_kv512_remat", 32, 512, 512, True, "xla", "dense"),
+    ("b32_q512_kv512_remat_pbwd", 32, 512, 512, True, "pallas", "dense"),
+    ("b64_q512_kv512_bce", 64, 512, 512, False, "xla", "block"),
+    ("b64_q512_kv512_remat", 64, 512, 512, True, "xla", "dense"),
+    ("b64_q512_kv512_remat_bce", 64, 512, 512, True, "xla", "block"),
 ]
 
 
@@ -90,16 +95,18 @@ def main():
         want = set(subset.split(","))
         configs = [c for c in configs if c[0] in want]
     if tiny:  # plumbing check (CPU): tiny batch, blocks fitting
-        # max_seq, always including one remat and one pallas-bwd config
+        # max_seq, always including one remat, one pallas-bwd, and one
+        # blockwise-CE config
         picked = (configs[:2] + [c for c in configs[2:] if c[4]][:1]
-                  + [c for c in configs[2:] if c[5] == "pallas"][:1])
-        configs = [(n, 1, min(bq, 128), min(bkv, 128), r, bw)
-                   for n, _, bq, bkv, r, bw in picked]
+                  + [c for c in configs[2:] if c[5] == "pallas"][:1]
+                  + [c for c in configs[2:] if c[6] == "block"][:1])
+        configs = [(n, 1, min(bq, 128), min(bkv, 128), r, bw, ce)
+                   for n, _, bq, bkv, r, bw, ce in picked]
 
     rng = np.random.default_rng(0)
     results = []
     by_name = {}
-    for name, batch, bq, bkv, remat, bwd in configs:
+    for name, batch, bq, bkv, remat, bwd, ce in configs:
         try:
             tokens = jnp.asarray(
                 rng.integers(0, cfg.vocab_size, (batch, cfg.max_seq)),
@@ -113,7 +120,9 @@ def main():
                 def body(carry, _):
                     p, o = carry
                     loss, grads = jax.value_and_grad(transformer.loss_fn)(
-                        p, tokens, cfg, attn_fn=attn, remat=remat)
+                        p, tokens, cfg, attn_fn=attn, remat=remat,
+                        ce_impl=("blockwise" if ce == "block" else "dense"),
+                        ce_block=min(2048, cfg.vocab_size))
                     updates, o = opt.update(grads, o)
                     return (optax.apply_updates(p, updates), o), loss
                 (_, _), losses = lax.scan(
@@ -132,7 +141,8 @@ def main():
                   f"(compile {compile_s:.0f}s)", flush=True)
             results.append((mfu, name))
             by_name[name] = {"batch": batch, "block_q": bq,
-                             "block_kv": bkv, "remat": remat, "bwd": bwd}
+                             "block_kv": bkv, "remat": remat, "bwd": bwd,
+                             "ce": ce}
         except Exception as e:  # noqa: BLE001 - keep sweeping
             print(f"{name:18s} FAILED: {str(e)[:160]}", flush=True)
     for mfu, name in sorted(results, reverse=True):

@@ -141,10 +141,13 @@ def _layer_apply(p, x, cfg, rope, attn_fn):
 
 
 def apply(params, tokens, cfg: Config, *, attn_fn=None,
-          logits_dtype=jnp.float32, remat=False, positions=None):
+          logits_dtype=jnp.float32, remat=False, positions=None,
+          return_hidden=False):
     """tokens [B, S] int32 -> logits [B, S, vocab] (``logits_dtype``,
     default float32; pass None to keep the compute dtype — the training
     loss does, so the [B,S,vocab] activation stays bfloat16 in HBM).
+    ``return_hidden=True`` skips the head matmul and returns the final
+    hidden states [B, S, dim] (the blockwise-CE loss consumes these).
 
     ``attn_fn(q, k, v) -> out`` on [B, S, H, D]; default is causal
     pallas flash attention.  Pass
@@ -200,12 +203,67 @@ def apply(params, tokens, cfg: Config, *, attn_fn=None,
 
     x, _ = lax.scan(body, x, params["layers"])
     x = ops.rmsnorm_reference(x, params["ln_f"])
+    if return_hidden:
+        return x
     logits = _matmul(x, params["head"])
     return logits if logits_dtype is None else logits.astype(logits_dtype)
 
 
+def _blockwise_nll(x, head, labels, block_v):
+    """Per-position next-token NLL WITHOUT materializing [N, vocab].
+
+    Streams the vocabulary in ``block_v`` slices: each scan step does
+    one [N, D] x [D, block_v] matmul and folds it into a running
+    (max, sumexp, gold-logit) online-logsumexp state — the CE analogue
+    of flash attention's online softmax.  The body is jax.checkpoint'd,
+    so the backward recomputes each block's logits instead of keeping
+    them: peak logits memory drops from N·V to N·block_v (at dim 1024 /
+    seq 2048 / vocab 16k / batch 32 that is ~2 GB of bf16 logits that
+    never hit HBM), buying batch headroom the sweep can spend.
+
+    ``x``: [N, D] final hidden states (compute dtype); ``head``:
+    [D, V] f32 params; ``labels``: [N] int.  Single-chip / data-parallel
+    path — under Megatron TP keep the dense CE (the column-parallel
+    head wants the per-shard logsumexp exchange instead).
+    """
+    n, _d = x.shape
+    v = head.shape[1]
+    if v % block_v:
+        raise ValueError(f"vocab {v} not divisible by ce_block {block_v}")
+    nb = v // block_v
+    # [nb, D, block_v] scan operand: reshape splits V contiguously
+    head_blocks = head.reshape(-1, nb, block_v).transpose(1, 0, 2)
+    labels = labels.astype(jnp.int32)
+
+    def body(carry, inp):
+        m, s, gold = carry
+        vb, w = inp
+        logits = jnp.dot(
+            x, w.astype(x.dtype), preferred_element_type=jnp.float32)
+        bm = jnp.max(logits, axis=-1)
+        nm = jnp.maximum(m, bm)
+        s = s * jnp.exp(m - nm) \
+            + jnp.sum(jnp.exp(logits - nm[:, None]), axis=-1)
+        base = vb * block_v
+        in_blk = (labels >= base) & (labels < base + block_v)
+        idx = jnp.clip(labels - base, 0, block_v - 1)
+        g = jnp.take_along_axis(logits, idx[:, None], axis=1)[:, 0]
+        gold = gold + jnp.where(in_blk, g, 0.0)
+        return (nm, s, gold), None
+
+    # finite lower bound, not -inf: exp(min - nm) underflows to exactly
+    # 0 like -inf would, but the backward pass never sees inf arithmetic
+    init = (jnp.full((n,), jnp.finfo(jnp.float32).min, jnp.float32),
+            jnp.zeros((n,), jnp.float32),
+            jnp.zeros((n,), jnp.float32))
+    (m, s, gold), _ = lax.scan(
+        jax.checkpoint(body), init,
+        (jnp.arange(nb), head_blocks))
+    return m + jnp.log(s) - gold
+
+
 def loss_fn(params, tokens, cfg: Config, *, attn_fn=None, remat=False,
-            labels=None, positions=None):
+            labels=None, positions=None, ce_impl="dense", ce_block=2048):
     """Next-token cross entropy (mean over B, S-1).
 
     Default: labels are ``tokens`` shifted by one (contiguous order).
@@ -214,25 +272,48 @@ def loss_fn(params, tokens, cfg: Config, *, attn_fn=None, remat=False,
     final global position) plus matching ``positions`` — see
     ``zigzag_lm_batch``.
 
-    Logits stay in the compute dtype (bfloat16); the softmax/CE
-    reductions accumulate in float32 — XLA fuses the upcast into the
-    reduce, so no [B, S, vocab] float32 tensor ever hits HBM (round-2
-    finding: the f32 logits path cost ~2 GB of HBM traffic per step at
-    dim 1024 / seq 2048 / vocab 16k)."""
-    logits = apply(params, tokens, cfg, attn_fn=attn_fn, logits_dtype=None,
-                   remat=remat, positions=positions)
-    if labels is None:
-        logits = logits[:, :-1]
-        labels = tokens[:, 1:]
-        valid = None
+    ``ce_impl="dense"`` (default): logits stay in the compute dtype
+    (bfloat16); the softmax/CE reductions accumulate in float32 — XLA
+    fuses the upcast into the reduce, so no [B, S, vocab] float32
+    tensor ever hits HBM (round-2 finding: the f32 logits path cost
+    ~2 GB of HBM traffic per step at dim 1024 / seq 2048 / vocab 16k).
+
+    ``ce_impl="blockwise"``: never materializes [B, S, vocab] at all —
+    the head matmul streams in ``ce_block``-wide vocab slices through an
+    online logsumexp (``_blockwise_nll``), checkpointed so the backward
+    recomputes each slice.  Single-chip / data-parallel option for when
+    logits memory bounds the batch size (a sweep axis)."""
+    if ce_impl not in ("dense", "blockwise"):
+        raise ValueError(f"unknown ce_impl {ce_impl!r}")
+    if ce_impl == "blockwise":
+        x = apply(params, tokens, cfg, attn_fn=attn_fn, remat=remat,
+                  positions=positions, return_hidden=True)
+        if labels is None:
+            x = x[:, :-1]
+            labels = tokens[:, 1:]
+            valid = None
+        else:
+            valid = labels >= 0
+            labels = jnp.maximum(labels, 0)
+        b, s, d = x.shape
+        nll = _blockwise_nll(
+            x.reshape(b * s, d), params["head"],
+            labels.reshape(b * s), ce_block).reshape(b, s)
     else:
-        valid = labels >= 0
-        labels = jnp.maximum(labels, 0)
-    lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
-    gold = jnp.take_along_axis(
-        logits, labels[..., None].astype(jnp.int32), axis=-1
-    )[..., 0].astype(jnp.float32)
-    nll = lse - gold
+        logits = apply(params, tokens, cfg, attn_fn=attn_fn,
+                       logits_dtype=None, remat=remat, positions=positions)
+        if labels is None:
+            logits = logits[:, :-1]
+            labels = tokens[:, 1:]
+            valid = None
+        else:
+            valid = labels >= 0
+            labels = jnp.maximum(labels, 0)
+        lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+        gold = jnp.take_along_axis(
+            logits, labels[..., None].astype(jnp.int32), axis=-1
+        )[..., 0].astype(jnp.float32)
+        nll = lse - gold
     if valid is None:
         return jnp.mean(nll)
     vf = valid.astype(jnp.float32)

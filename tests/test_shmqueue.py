@@ -151,8 +151,6 @@ def test_columnar_fast_path_wraparound_stream():
 
 
 def _columnar_producer(name, n):
-    from tests.test_shmqueue import _make_chunk
-
     q = shm.ShmQueue(name, create=False, producer=True)
     _, chunk = _make_chunk(n=16, hw=5)
     for _ in range(n):

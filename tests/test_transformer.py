@@ -44,6 +44,46 @@ def test_flash_and_reference_impls_agree():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
 
 
+def test_blockwise_ce_matches_dense():
+    """ce_impl='blockwise' (streamed vocab, online logsumexp, no [N,V]
+    tensor) must match the dense CE in value AND gradients — including
+    with an ignore-mask label layout and a vocab block smaller than,
+    equal to, and dividing the vocab unevenly (error)."""
+    params = transformer.init(jax.random.PRNGKey(0), CFG)
+    toks = _tokens(jax.random.PRNGKey(1))
+
+    for block in (32, 48, 96):
+        dense = transformer.loss_fn(params, toks, CFG, ce_impl="dense")
+        blk = transformer.loss_fn(params, toks, CFG, ce_impl="blockwise",
+                                  ce_block=block)
+        np.testing.assert_allclose(float(blk), float(dense), rtol=2e-5)
+
+    gd = jax.grad(transformer.loss_fn)(params, toks, CFG, ce_impl="dense")
+    gb = jax.grad(transformer.loss_fn)(params, toks, CFG,
+                                       ce_impl="blockwise", ce_block=32)
+    for (ka, a), (kb, b) in zip(
+            jax.tree_util.tree_leaves_with_path(gd),
+            jax.tree_util.tree_leaves_with_path(gb)):
+        assert str(ka) == str(kb)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-4,
+                                   err_msg=str(ka))
+
+    # masked-label layout (zigzag path): -1 positions ignored identically
+    labels = jnp.where(jnp.arange(32)[None, :] % 5 == 0, -1,
+                       jnp.roll(toks, -1, axis=1))
+    dense = transformer.loss_fn(params, toks, CFG, labels=labels)
+    blk = transformer.loss_fn(params, toks, CFG, labels=labels,
+                              ce_impl="blockwise", ce_block=48)
+    np.testing.assert_allclose(float(blk), float(dense), rtol=2e-5)
+
+    with pytest.raises(ValueError, match="not divisible"):
+        transformer.loss_fn(params, toks, CFG, ce_impl="blockwise",
+                            ce_block=40)
+    with pytest.raises(ValueError, match="unknown ce_impl"):
+        transformer.loss_fn(params, toks, CFG, ce_impl="nope")
+
+
 def test_loss_decreases_single_device():
     params = transformer.init(jax.random.PRNGKey(0), CFG)
     toks = _tokens(jax.random.PRNGKey(1))
