@@ -36,6 +36,22 @@ run() {
   echo "-- rc=$? --" | tee -a "$log"
 }
 
+# Bounded liveness probe (default 5 min) BEFORE any big compile: round 3
+# lost the whole session to a tunnel that died mid-ResNet-compile with no
+# signal.  A failed/hung probe ABORTS — every later step's `import jax`
+# would hang unbounded against the same dead tunnel.
+if [ "${TFOS_SESSION_SMOKE:-0}" != "1" ]; then
+  echo "-- tpu_probe --" | tee -a "$log"
+  timeout "${TFOS_SESSION_PROBE_TIMEOUT:-300}" python scripts/tpu_probe.py 2>&1 | tee -a "$log"
+  probe_rc=${PIPESTATUS[0]}
+  echo "-- rc=$probe_rc --" | tee -a "$log"
+  if [ "$probe_rc" != "0" ]; then
+    echo "ABORT: TPU probe failed (rc=$probe_rc; 124=timeout/hang, 2=cpu \
+backend, 3=wrong result) - tunnel/pool is sick, not claiming further" | tee -a "$log"
+    exit "$probe_rc"
+  fi
+fi
+
 run python scripts/sweep_resnet.py --steps "${TFOS_SESSION_RESNET_STEPS:-20}" --image "${TFOS_SESSION_IMAGE:-224}" --promote
 # promoted-config args come first so $profile_extra (smoke mode's
 # --batch 4) wins argparse's last-takes-effect — a CPU dry run must

@@ -1,0 +1,50 @@
+"""Tiny TPU liveness probe for the perf session: claim the backend,
+run one small matmul, print device + timing, exit.  A hang or error
+here (bounded by the caller's timeout, default 5 min) means the
+tunnel/pool is sick — better to learn that up front than 25 minutes
+into the first ResNet compile (the round-3 failure mode).
+
+Exit codes: 0 healthy; 2 backend is CPU (no TPU behind the tunnel);
+3 device returned a wrong result.
+"""
+
+import json
+import sys
+import time
+
+
+def main():
+    t0 = time.perf_counter()
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    t_backend = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    y = (x @ x).sum()
+    val = float(y)
+    t_compute = time.perf_counter() - t0
+
+    result_ok = abs(val - 256 * 256 * 256) < 1e-3 * 256 ** 3
+    print(json.dumps({
+        "device": str(dev),
+        "kind": getattr(dev, "device_kind", "?"),
+        "platform": dev.platform,
+        "backend_init_s": round(t_backend, 1),
+        "first_compute_s": round(t_compute, 1),
+        "result_ok": result_ok,
+    }), flush=True)
+    if dev.platform == "cpu":
+        print("probe: backend is CPU - no TPU behind the tunnel",
+              file=sys.stderr, flush=True)
+        raise SystemExit(2)
+    if not result_ok:
+        print(f"probe: device returned wrong result ({val})",
+              file=sys.stderr, flush=True)
+        raise SystemExit(3)
+
+
+if __name__ == "__main__":
+    main()
