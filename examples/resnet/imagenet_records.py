@@ -1,0 +1,65 @@
+"""Shared ImageNet record decode for the prep tool and the training
+example — ONE definition of what a valid record is, so the dataset
+written by ``imagenet_data_setup.py`` and the records accepted by
+``resnet_imagenet_spark.py`` can never drift apart.
+
+Two layouts are understood: this repo's writers ("image" bytes +
+"label", 0-based) and the TF-official ImageNet keys ("image/encoded"
+JPEG/PNG bytes + "image/class/label", 1-based).
+"""
+
+import io
+
+import numpy as np
+
+_JPEG_MAGIC = b"\xff\xd8"
+_PNG_MAGIC = b"\x89PNG"
+
+
+def decode_record(feats, image_size):
+    """Normalize one record to ``(uint8 [H, W, 3] array, 0-based int)``.
+
+    ``feats``: {name: value} or {name: [value]} (both the dfutil-loaded
+    and raw decode_example shapes).  Raises KeyError when image/label
+    fields are missing and ValueError when the payload is neither an
+    exact-size raw buffer nor JPEG/PNG — callers choose skip vs fail.
+
+    Payload rule (order matters): JPEG/PNG magic wins over the size
+    heuristic — a compressed image whose byte length happens to equal
+    H*W*3 must be decoded, not baked into the dataset as garbage
+    "raw" pixels.
+    """
+    data = feats.get("image", feats.get("image/encoded"))
+    if data is None:
+        raise KeyError(
+            f"record has neither 'image' nor 'image/encoded' features "
+            f"(got {sorted(feats)})")
+    if isinstance(data, list):
+        data = data[0]
+    if "label" in feats:
+        label = feats["label"]
+    elif "image/class/label" in feats:
+        label = feats["image/class/label"]
+        label = (label[0] if isinstance(label, list) else label) - 1
+    else:
+        raise KeyError(
+            f"record has neither 'label' nor 'image/class/label' "
+            f"(got {sorted(feats)})")
+    if isinstance(label, list):
+        label = label[0]
+
+    compressed = data[:2] == _JPEG_MAGIC or data[:4] == _PNG_MAGIC
+    if compressed:
+        from PIL import Image  # host-side decode, one per record
+
+        img = Image.open(io.BytesIO(data)).convert("RGB")
+        if img.size != (image_size, image_size):
+            img = img.resize((image_size, image_size), Image.BILINEAR)
+        return np.asarray(img, np.uint8), int(label)
+    raw = np.frombuffer(data, dtype=np.uint8)
+    if raw.size == image_size * image_size * 3:
+        return raw.reshape(image_size, image_size, 3), int(label)
+    raise ValueError(
+        f"image payload is {raw.size} bytes: neither "
+        f"{image_size}x{image_size}x3 raw uint8 nor JPEG/PNG — check "
+        f"--image_size against the dataset")

@@ -18,6 +18,12 @@ local engine (TPU VM / laptop, no Spark install):
 The training loop is the framework's fast path: columnar shm-ring feed →
 DataFeed → infeed.device_feed (double-buffered host→HBM staging) → a
 donated, mesh-sharded jit train step; gradients all-reduce over ICI.
+
+For JPEG TFRecords, run ``examples/resnet/imagenet_data_setup.py`` once
+first: python-side PIL decode is GIL-bound (~700 img/s measured) and
+would starve the chip, so the setup tool decodes in parallel across
+engine executors into raw uint8 records this loop feeds at memory speed
+(the in-loop decode below remains as a fallback for ad-hoc runs).
 """
 
 import argparse
@@ -25,6 +31,7 @@ import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
 def main_fun(args, ctx):
@@ -146,38 +153,13 @@ def _records(args, engine):
         )
         image = args.image_size
 
+        # ONE definition of record validity, shared with the prep tool
+        # (imagenet_data_setup.py): raw uint8 "image"/"label" or
+        # TF-official JPEG "image/encoded"/"image/class/label" (1-based)
+        import imagenet_records
+
         def to_row(rec):
-            # two layouts: raw uint8 under "image"/"label" (this repo's
-            # writers), or the TF-official ImageNet keys with JPEG bytes
-            # ("image/encoded", "image/class/label" — 1-based labels!)
-            data = rec.get("image", rec.get("image/encoded"))
-            if data is None:
-                raise ValueError(
-                    f"record has neither 'image' nor 'image/encoded' "
-                    f"features (got {sorted(rec)})")
-            if "label" in rec:
-                label = rec["label"]
-            else:
-                label = rec["image/class/label"]
-                label = (label[0] if isinstance(label, list) else label) - 1
-            if isinstance(label, list):
-                label = label[0]
-            raw = np.frombuffer(data, dtype=np.uint8)
-            if raw.size == image * image * 3:
-                return raw.reshape(image, image, 3), int(label)
-            if not (data[:2] == b"\xff\xd8" or data[:4] == b"\x89PNG"):
-                raise ValueError(
-                    f"image payload is {raw.size} bytes: neither "
-                    f"{image}x{image}x3 raw uint8 nor JPEG/PNG — check "
-                    f"--image_size against the dataset")
-            import io
-
-            from PIL import Image  # host-side decode, one per record
-
-            img = Image.open(io.BytesIO(data)).convert("RGB")
-            if img.size != (image, image):
-                img = img.resize((image, image), Image.BILINEAR)
-            return np.asarray(img, np.uint8), int(label)
+            return imagenet_records.decode_record(rec, image)
 
         if ds.num_partitions < args.cluster_size:
             # min_partitions striping should prevent this; keep a

@@ -1,0 +1,109 @@
+"""ImageNet example ladder: JPEG TFRecords -> imagenet_data_setup
+(engine-parallel decode-once prep) -> resnet_imagenet_spark training
+from raw records via shard striping + the columnar feed."""
+
+import io
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env():
+    env = {k: v for k, v in os.environ.items() if not k.startswith("TFOS_")}
+    env.update(PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    return env
+
+
+def test_decode_record_rules():
+    """The shared decode helper: JPEG magic beats the size heuristic
+    (a compressed payload of exactly H*W*3 bytes must decode, not pass
+    through as 'raw'), missing fields raise, 1-based labels shift."""
+    sys.path.insert(0, os.path.join(REPO, "examples", "resnet"))
+    try:
+        import imagenet_records as IR
+    finally:
+        sys.path.pop(0)
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    hw = 24  # big enough that a q20 JPEG fits under hw*hw*3 bytes
+    raw = rng.integers(0, 256, (hw, hw, 3), np.uint8)
+
+    arr, label = IR.decode_record(
+        {"image": raw.tobytes(), "label": 3}, hw)
+    np.testing.assert_array_equal(arr, raw)
+    assert label == 3
+
+    # a JPEG padded to exactly hw*hw*3 bytes must still DECODE
+    buf = io.BytesIO()
+    Image.fromarray(raw, "RGB").save(buf, "JPEG", quality=20)
+    payload = buf.getvalue()
+    assert len(payload) < hw * hw * 3
+    payload = payload + b"\0" * (hw * hw * 3 - len(payload))
+    arr, label = IR.decode_record(
+        {"image/encoded": [payload], "image/class/label": [4]}, hw)
+    assert arr.shape == (hw, hw, 3)
+    assert label == 3  # 1-based input
+
+    with pytest.raises(KeyError, match="label"):
+        IR.decode_record({"image": raw.tobytes()}, hw)
+    with pytest.raises(KeyError, match="image"):
+        IR.decode_record({"label": 1}, hw)
+    with pytest.raises(ValueError, match="neither"):
+        IR.decode_record({"image": b"junkbytes", "label": 1}, hw)
+
+
+def test_prep_then_train(tmp_path):
+    from PIL import Image
+
+    from tensorflowonspark_tpu import recordio
+
+    jpeg_dir = tmp_path / "jpeg"
+    jpeg_dir.mkdir()
+    rng = np.random.default_rng(0)
+    with recordio.TFRecordWriter(str(jpeg_dir / "part-r-00000")) as w:
+        for i in range(48):
+            arr = rng.integers(0, 256, (40, 48, 3), np.uint8)  # non-square
+            buf = io.BytesIO()
+            Image.fromarray(arr, "RGB").save(buf, "JPEG", quality=92)
+            w.write(recordio.encode_example({
+                "image/encoded": ("bytes", [buf.getvalue()]),
+                "image/class/label": ("int64", [1 + i % 8]),  # 1-based
+            }))
+
+    raw_dir = tmp_path / "raw"
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "examples/resnet/imagenet_data_setup.py"),
+         "--input_dir", str(jpeg_dir), "--output_dir", str(raw_dir),
+         "--image_size", "32", "--num_executors", "2"],
+        cwd=str(tmp_path), env=_env(), capture_output=True, text=True,
+        timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "wrote 48 raw 32px records" in out.stdout
+
+    # prepped records round-trip at the right shape/labels
+    rec = next(iter(recordio.TFRecordReader(
+        str(next(raw_dir.glob("part-r-*"))))))
+    feats = {k: v for k, (_kind, v) in recordio.decode_example(rec).items()}
+    assert len(feats["image"][0]) == 32 * 32 * 3
+    assert 0 <= feats["label"][0] < 8  # 1-based input became 0-based
+
+    train = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "examples/resnet/resnet_imagenet_spark.py"),
+         "--cluster_size", "2", "--batch_size", "8", "--image_size", "32",
+         "--steps", "2", "--num_classes", "8",
+         "--data_dir", str(raw_dir),
+         "--model_dir", str(tmp_path / "ckpt")],
+        cwd=str(tmp_path), env=_env(), capture_output=True, text=True,
+        timeout=420)
+    assert train.returncode == 0, train.stdout[-3000:] + train.stderr[-2000:]
+    assert "final: step=" in train.stdout
