@@ -148,6 +148,27 @@ def test_sharded_train_step_4axis_mesh(eight_devices, attn):
     np.testing.assert_allclose(float(loss), float(ref_loss), atol=3e-4)
 
 
+def test_blockwise_ce_compiles_sharded(eight_devices):
+    """Blockwise CE under a data x fsdp mesh: the vocab-block scan must
+    compile and grad against sharded params/batch (documented as the
+    single-chip/data-parallel option — this pins that envelope)."""
+    mesh = Mesh(np.array(eight_devices).reshape(4, 2), ("data", "fsdp"))
+    params = transformer.init(jax.random.PRNGKey(0), CFG)
+    toks = _tokens(jax.random.PRNGKey(1), b=4)
+    specs = transformer.param_specs(CFG, mesh=mesh)
+    with mesh:
+        params = jax.device_put(
+            params, jax.tree.map(lambda s: NamedSharding(mesh, s), specs))
+        toks = jax.device_put(toks, NamedSharding(mesh, P("data", None)))
+        loss, grads = jax.jit(
+            jax.value_and_grad(lambda p, t: transformer.loss_fn(
+                p, t, CFG, ce_impl="blockwise", ce_block=32)))(params, toks)
+        dense = transformer.loss_fn(params, toks, CFG)
+    np.testing.assert_allclose(float(loss), float(dense), rtol=2e-5)
+    assert np.isfinite(float(jax.tree_util.tree_reduce(
+        lambda a, b: a + jnp.sum(jnp.abs(b)), grads, 0.0)))
+
+
 def test_remat_matches_no_remat():
     """jax.checkpoint over the scanned layer must not change loss or
     gradients (it only changes what the backward pass keeps resident)."""
