@@ -40,19 +40,19 @@ FED_CHUNK = int(os.environ.get("TFOS_FED_CHUNK", "64"))
 
 
 def _feeder_main(ring_name, mgr_addr, authkey_hex, total_records, image,
-                 pool=None):
+                 pool=None, columnar=True):
     """Feeder child (no jax): generate (uint8 image, label) records and push
     chunks through the shm ring exactly like node.train's feeder closure —
     including its columnar chunk encoder (n-D image fields go over the
-    wire as dense flattened columns; TFOS_BENCH_FED_COLUMNAR=0 reverts to
-    pickled row lists for A/B)."""
+    wire as dense flattened columns; columnar=False reverts to pickled
+    row lists for the A/B lane)."""
     import numpy as np
 
     from tensorflowonspark_tpu import manager as tfmanager
     from tensorflowonspark_tpu import node as tfnode
     from tensorflowonspark_tpu.recordio import shm as shmq
 
-    if os.environ.get("TFOS_BENCH_FED_COLUMNAR", "1") != "0":
+    if columnar:
         encode = tfnode._make_chunk_encoder()
     else:
         def encode(chunk):
@@ -82,7 +82,7 @@ def _feeder_main(ring_name, mgr_addr, authkey_hex, total_records, image,
     mgr.set("feeder_done", 1)
 
 
-def _fed_setup(batch, image, steps):
+def _fed_setup(batch, image, steps, columnar=True, tag=""):
     """Pre-jax setup of the fed pipeline: IPC manager + shm ring + a real
     feeder process.  Must run before jax/the TPU tunnel initializes in
     this process: the feeder child is spawned with PYTHONPATH cleared so
@@ -98,7 +98,7 @@ def _fed_setup(batch, image, steps):
         return None
     authkey = secrets.token_bytes(16)
     mgr = tfmanager.start(authkey, ["input", "output", "error", "control"])
-    ring_name = f"/tfos-bench-{os.getpid():x}"
+    ring_name = f"/tfos-bench-{os.getpid():x}{tag}"
     # modest capacity on purpose: a huge ring would let the feeder run
     # steps ahead during compile and overstate steady-state throughput.
     # Must hold several chunks or producer/consumer serialize — scale
@@ -115,7 +115,8 @@ def _fed_setup(batch, image, steps):
     try:
         proc = ctx.Process(
             target=_feeder_main,
-            args=(ring_name, list(mgr.address), authkey.hex(), total, image),
+            args=(ring_name, list(mgr.address), authkey.hex(), total, image,
+                  None, columnar),
             daemon=True,
         )
         proc.start()
@@ -125,12 +126,16 @@ def _fed_setup(batch, image, steps):
         else:
             os.environ["PYTHONPATH"] = saved
     return {"mgr": mgr, "ring": ring, "proc": proc, "steps": steps,
-            "batch": batch, "image": image}
+            "batch": batch, "image": image, "columnar": columnar}
 
 
-def _fed_run(fed, step_fn, params, state, opt_state):
+def _fed_run(fed, step_fn, params, state, opt_state, loop_ips=None):
     """Train from the fed pipeline on the device; report fed throughput,
-    infeed stall, and the device-resident per-dispatch comparator."""
+    infeed stall, and the device-resident per-dispatch comparator.
+
+    ``loop_ips``: pass the comparator number from an earlier lane (same
+    step_fn/shapes) to skip re-measuring it — the A/B counter-lane must
+    not double the per-dispatch device time spent on fed benching."""
     import jax
     import numpy as np
 
@@ -140,21 +145,23 @@ def _fed_run(fed, step_fn, params, state, opt_state):
 
     batch, image, steps = fed["batch"], fed["image"], fed["steps"]
     fed_step = jax.jit(step_fn, donate_argnums=(0, 1, 2))
-
-    # comparator: same per-dispatch step loop on a device-resident batch
-    rng = np.random.default_rng(0)
-    res_imgs = jax.device_put(
-        rng.integers(0, 256, (batch, image, image, 3), dtype=np.uint8)
-    )
-    res_labels = jax.device_put(rng.integers(0, 1000, batch).astype(np.int32))
     p, s, o = params, state, opt_state
-    p, s, o, loss, _ = fed_step(p, s, o, res_imgs, res_labels)  # compile
-    loss.block_until_ready()
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        p, s, o, loss, _ = fed_step(p, s, o, res_imgs, res_labels)
-    loss.block_until_ready()
-    loop_ips = batch * steps / (time.perf_counter() - t0)
+
+    if loop_ips is None:
+        # comparator: same per-dispatch step loop, device-resident batch
+        rng = np.random.default_rng(0)
+        res_imgs = jax.device_put(
+            rng.integers(0, 256, (batch, image, image, 3), dtype=np.uint8)
+        )
+        res_labels = jax.device_put(
+            rng.integers(0, 1000, batch).astype(np.int32))
+        p, s, o, loss, _ = fed_step(p, s, o, res_imgs, res_labels)  # compile
+        loss.block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            p, s, o, loss, _ = fed_step(p, s, o, res_imgs, res_labels)
+        loss.block_until_ready()
+        loop_ips = batch * steps / (time.perf_counter() - t0)
 
     metrics = TrainMetrics()
     feed = DataFeed(fed["mgr"], train_mode=True,
@@ -209,7 +216,7 @@ def _fed_run(fed, step_fn, params, state, opt_state):
 
     threading.Thread(target=stall_watch, daemon=True).start()
 
-    columnar = os.environ.get("TFOS_BENCH_FED_COLUMNAR", "1") != "0"
+    columnar = fed["columnar"]
     if columnar:
         # dense-array pull: aligned chunks pass through zero-copy, the
         # per-record python loop + np.stack (the 12k img/s wall, PERF.md)
@@ -328,12 +335,24 @@ def main():
         "TFOS_BENCH_REMAT",
         "1" if promoted.get("remat", False) else "0") != "0"
 
-    fed_ctx = None
+    fed_ctx = fed_ctx_rows = None
     if os.environ.get("TFOS_BENCH_FED", "1") != "0":
+        columnar = os.environ.get("TFOS_BENCH_FED_COLUMNAR", "1") != "0"
         try:
-            fed_ctx = _fed_setup(batch, image, steps)
+            fed_ctx = _fed_setup(batch, image, steps, columnar=columnar)
         except Exception as e:  # noqa: BLE001 - fed lane is best-effort
             fed_ctx = {"setup_error": str(e)[:200]}
+        # the A/B counter-lane (row-list wire + np.stack consumer): its
+        # feeder must ALSO spawn pre-jax — forking the manager server
+        # after the accelerator runtime is live is fork-after-threads
+        # territory.  The extra feeder just blocks on its full ring
+        # until its lane runs.
+        if columnar and os.environ.get("TFOS_BENCH_FED_AB", "1") != "0":
+            try:
+                fed_ctx_rows = _fed_setup(batch, image, steps,
+                                          columnar=False, tag="-rows")
+            except Exception as e:  # noqa: BLE001
+                fed_ctx_rows = {"setup_error": str(e)[:200]}
 
     import jax
     import jax.numpy as jnp
@@ -435,6 +454,26 @@ def main():
                 extra["fed"] = _fed_run(fed_ctx, step_fn, params, state, opt_state)
             except Exception as e:  # noqa: BLE001 - report, don't mask resnet
                 extra["fed"] = {"error": str(e)[:200]}
+    if fed_ctx_rows is not None:
+        # row-wire counter-lane: same train step, pickled row lists +
+        # np.stack consumer — the A/B lands in ONE bench line
+        if "setup_error" in fed_ctx_rows:
+            extra["fed_rows"] = fed_ctx_rows
+        else:
+            try:
+                # the first fed lane DONATED the train state; re-init
+                # (compile-cached, so this is one cheap dispatch)
+                p2, s2, o2 = init_all(jax.random.PRNGKey(0))
+                extra["fed_rows"] = _fed_run(
+                    fed_ctx_rows, step_fn, p2, s2, o2,
+                    loop_ips=extra.get("fed", {}).get(
+                        "loop_images_per_sec"))
+            except Exception as e:  # noqa: BLE001
+                extra["fed_rows"] = {"error": str(e)[:200]}
+        a = extra.get("fed", {}).get("images_per_sec_per_chip")
+        b = extra.get("fed_rows", {}).get("images_per_sec_per_chip")
+        if a and b:
+            extra["fed_rows"]["columnar_speedup"] = round(a / b, 3)
 
     if os.environ.get("TFOS_BENCH_TRANSFORMER", "1") != "0":
         try:

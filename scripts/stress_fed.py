@@ -31,9 +31,8 @@ def run_mode(mode, batch, image, steps):
     import bench
     from tensorflowonspark_tpu.feed import DataFeed
 
-    os.environ["TFOS_BENCH_FED_COLUMNAR"] = (
-        "1" if mode == "columnar" else "0")
-    fed = bench._fed_setup(batch, image, steps)
+    fed = bench._fed_setup(batch, image, steps,
+                           columnar=(mode == "columnar"), tag=f"-{mode}")
     if fed is None:
         return {"mode": mode, "error": "shm unavailable"}
     feed = DataFeed(fed["mgr"], train_mode=True,
