@@ -528,10 +528,13 @@ def _transformer_bench(dev, on_tpu):
         # base config fits one v5e with f32 adam state; the sweep's
         # winner (scripts/sweep_transformer.py --promote) can raise
         # batch / change flash blocks / enable remat via
-        # bench_config.json's "transformer" section
+        # bench_config.json's "transformer" section.  attn="reference"
+        # is the sweep's recorded fallback when the compiled pallas
+        # forward failed on this backend.
         cfg = transformer.Config(
             vocab_size=16384, dim=1024, n_layers=8, n_heads=8,
-            max_seq=2048, dtype="bfloat16", attn_impl="flash",
+            max_seq=2048, dtype="bfloat16",
+            attn_impl=promoted.get("attn", "flash"),
         )
         batch, steps = int(promoted.get("batch", 8)), 10
     else:
@@ -543,7 +546,8 @@ def _transformer_bench(dev, on_tpu):
     remat = bool(promoted.get("remat", False))
     ce_impl = ("blockwise" if promoted.get("ce") == "block" else "dense")
     attn_fn = None
-    if promoted.get("block_q") or promoted.get("block_kv"):
+    if (promoted.get("block_q") or promoted.get("block_kv")) \
+            and promoted.get("attn", "flash") == "flash":
         import functools
 
         from tensorflowonspark_tpu import ops

@@ -89,6 +89,36 @@ def main():
     jax.block_until_ready(params)
     print("init done", flush=True)
 
+    # pre-flight: the compiled (Mosaic-lowered) pallas forward has never
+    # run before the first chip session — if it miscompiles, every config
+    # here uses it and the sweep would produce NOTHING.  Probe once; on
+    # failure sweep with the XLA reference attention instead (slower but
+    # a number, recorded as attn="reference" for the bench to honor).
+    attn_base, attn_name = ops.flash_attention, "flash"
+    try:
+        # probe at the BLOCK SIZES the real configs use, on random input,
+        # and check numerics against the XLA reference — a kernel that
+        # miscompiles only at production shapes, or compiles but returns
+        # garbage, must also trip the fallback
+        pseq = min(1024, cfg.max_seq)
+        pk = jax.random.split(jax.random.PRNGKey(7), 3)
+        q, k, v = (jax.random.normal(
+            kk, (1, pseq, cfg.n_heads, cfg.head_dim), cfg.compute_dtype)
+            for kk in pk)
+        got = jax.jit(functools.partial(
+            ops.flash_attention, causal=True, block_q=512, block_kv=512,
+        ))(q, k, v)
+        want = ops.mha_reference(q, k, v, causal=True)
+        err = float(jnp.max(jnp.abs(
+            got.astype(jnp.float32) - want.astype(jnp.float32))))
+        if not err < 5e-2:  # bf16-scale tolerance; also catches NaN
+            raise RuntimeError(f"probe numerics off: max err {err}")
+    except Exception as e:  # noqa: BLE001 - first-run kernel failure
+        print(f"pallas flash forward FAILED on this backend: "
+              f"{str(e)[:200]}\nsweeping with the XLA reference "
+              f"attention instead", flush=True)
+        attn_base, attn_name = ops.mha_reference, "reference"
+
     configs = list(CONFIGS)
     subset = os.environ.get("TFOS_SWEEP")
     if subset:
@@ -106,14 +136,30 @@ def main():
     rng = np.random.default_rng(0)
     results = []
     by_name = {}
+    seen_ref = set()  # reference attn ignores blocks: dedupe configs
     for name, batch, bq, bkv, remat, bwd, ce in configs:
+        if attn_name == "reference":
+            if bwd == "pallas":
+                print(f"{name:18s} SKIPPED (pallas unavailable)",
+                      flush=True)
+                continue
+            key = (batch, remat, ce)
+            if key in seen_ref:  # blocks don't matter without pallas —
+                # don't burn multi-minute tunnel compiles on duplicates
+                print(f"{name:18s} SKIPPED (duplicate under reference "
+                      f"attn)", flush=True)
+                continue
+            seen_ref.add(key)
         try:
             tokens = jnp.asarray(
                 rng.integers(0, cfg.vocab_size, (batch, cfg.max_seq)),
                 jnp.int32)
-            attn = functools.partial(
-                ops.flash_attention, causal=True, block_q=bq, block_kv=bkv,
-                bwd_impl=bwd)
+            if attn_name == "flash":
+                attn = functools.partial(
+                    attn_base, causal=True, block_q=bq,
+                    block_kv=bkv, bwd_impl=bwd)
+            else:
+                attn = functools.partial(attn_base, causal=True)
 
             @jax.jit
             def run(params, opt_state, tokens):
@@ -142,7 +188,7 @@ def main():
             results.append((mfu, name))
             by_name[name] = {"batch": batch, "block_q": bq,
                              "block_kv": bkv, "remat": remat, "bwd": bwd,
-                             "ce": ce}
+                             "ce": ce, "attn": attn_name}
         except Exception as e:  # noqa: BLE001 - keep sweeping
             print(f"{name:18s} FAILED: {str(e)[:160]}", flush=True)
     for mfu, name in sorted(results, reverse=True):
