@@ -20,6 +20,13 @@ fi
 log=${TFOS_PERF_LOG:-perf_session.log}
 echo "== tpu perf session $(date -u +%FT%TZ) ==" | tee -a "$log"
 
+# persistent XLA compilation cache shared across the session's processes:
+# the winning config is compiled by the sweep, then AGAIN by profile,
+# bench, and the fed lane — each a multi-minute first-compile through the
+# tunnel.  The disk cache turns the repeats into loads.
+export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/tfos_xla_cache}
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+
 # TFOS_SESSION_SMOKE=1: CPU dry run of the WHOLE session pipeline (tiny
 # shapes, promote refused by the sweeps, bench skipped) so script bugs
 # surface here, not in the first minutes of a live chip claim.
