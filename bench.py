@@ -296,6 +296,39 @@ def bench_config_path():
         os.path.dirname(os.path.abspath(__file__)), "bench_config.json")
 
 
+def _tunnel_note():
+    """Pre-jax diagnosis of the axon relay: when the loopback tunnel is
+    dead, `import jax` HANGS (the site hook dials the pool at interpreter
+    startup), so an unattended bench run dies as an opaque rc=124 with no
+    explanation (round-3 failure mode: BENCH_r03 was exactly that).
+    Print the diagnosis to stderr BEFORE any jax import so the round's
+    bench log says why; TFOS_BENCH_REQUIRE_TUNNEL=1 additionally aborts
+    fast (rc=3) instead of hanging for the driver's whole timeout."""
+    import socket
+    import sys
+
+    if os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip() == "cpu":
+        return  # explicit CPU run: the tunnel is irrelevant
+    if "axon" not in os.environ.get("PYTHONPATH", "").lower() and \
+            not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        return  # no tunnel in play (CI)
+    host = os.environ.get("PALLAS_AXON_POOL_IPS", "127.0.0.1").split(",")[0]
+    port = int(os.environ.get("TFOS_TUNNEL_PORT", "8082"))
+    try:
+        with socket.create_connection((host, port), timeout=2):
+            return  # relay listening: proceed normally
+    except OSError:
+        pass
+    print(f"bench: WARNING axon relay {host}:{port} is not listening - "
+          "the TPU tunnel looks DEAD; jax backend init will likely hang "
+          "(this is the round-3 rc=124 failure mode)",
+          file=sys.stderr, flush=True)
+    if os.environ.get("TFOS_BENCH_REQUIRE_TUNNEL") == "1":
+        print("bench: TFOS_BENCH_REQUIRE_TUNNEL=1 - aborting fast",
+              file=sys.stderr, flush=True)
+        raise SystemExit(3)
+
+
 def _promoted_config():
     """Optional bench_config.json at the repo root: sweep winners
     applied to the TPU bench without code edits.  Top-level keys are the
@@ -319,6 +352,7 @@ def _promoted_config():
 
 
 def main():
+    _tunnel_note()
     on_tpu = _on_tpu_guess()
     promoted = _promoted_config() if on_tpu else {}
     batch = int(os.environ.get(
