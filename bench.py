@@ -368,6 +368,9 @@ def main():
     remat = os.environ.get(
         "TFOS_BENCH_REMAT",
         "1" if promoted.get("remat", False) else "0") != "0"
+    bn_fused = os.environ.get(
+        "TFOS_BENCH_BN_FUSED",
+        "1" if promoted.get("bn_fused", True) else "0") != "0"
 
     fed_ctx = fed_ctx_rows = None
     if os.environ.get("TFOS_BENCH_FED", "1") != "0":
@@ -440,7 +443,7 @@ def main():
 
     params, state, opt_state = init_all(jax.random.PRNGKey(0))
     step_fn = resnet.make_train_step(opt, depth=50, stem_s2d=stem_s2d,
-                                     remat=remat)
+                                     remat=remat, bn_fused=bn_fused)
 
     rng = np.random.default_rng(0)
     images = jnp.asarray(rng.random((batch, image, image, 3), dtype=np.float32),
@@ -472,7 +475,7 @@ def main():
     extra = {
         "images_per_sec_per_chip": round(imgs_per_sec, 1),
         "batch": batch, "image": image, "steps": steps,
-        "stem_s2d": stem_s2d, "remat": remat,
+        "stem_s2d": stem_s2d, "remat": remat, "bn_fused": bn_fused,
         "device": str(dev), "platform": dev.platform,
         "loss": loss,
     }

@@ -55,6 +55,8 @@ def main():
     ap.add_argument("--image", type=int, default=224)
     ap.add_argument("--stem", choices=("s2d", "7x7"), default="s2d")
     ap.add_argument("--remat", action="store_true")
+    ap.add_argument("--bn", choices=("fused", "plain"), default="fused",
+                    help="BatchNorm backward: custom-VJP fused vs autodiff")
     ap.add_argument("--out", default=None,
                     help="also write the breakdown as markdown (e.g. PERF.md)")
     args = ap.parse_args()
@@ -83,7 +85,8 @@ def main():
                       else "7x7")
     step_fn = resnet.make_train_step(opt, depth=50,
                                      stem_s2d=(args.stem == "s2d"),
-                                     remat=args.remat)
+                                     remat=args.remat,
+                                     bn_fused=(args.bn == "fused"))
 
     rng = np.random.default_rng(0)
     images = jnp.asarray(rng.random((args.batch, args.image, args.image, 3),
@@ -121,7 +124,7 @@ def main():
     report = [f"# ResNet-50 step-time breakdown",
               f"",
               f"batch={args.batch} image={args.image} stem={effective_stem} "
-              f"remat={args.remat} steps={args.steps}; "
+              f"remat={args.remat} bn={args.bn} steps={args.steps}; "
               f"measured {ms_per_step:.1f} ms/step "
               f"({args.batch / (ms_per_step / 1000):.0f} img/s).",
               ""]

@@ -20,6 +20,8 @@ def main():
         args += ["--stem", "7x7"]
     if cfg.get("remat"):
         args += ["--remat"]
+    if not cfg.get("bn_fused", True):
+        args += ["--bn", "plain"]
     print(" ".join(args))
 
 

@@ -2,8 +2,10 @@
 a single TPU claim (the tunnel serializes claims, so N processes would pay
 N claim round-trips).
 
-Sweeps: stem (s2d vs 7x7), batch size, remat; prints one line per config
-and a final ranking.  Use TFOS_SWEEP=batch256,batch512,... to subset.
+Sweeps: stem (s2d vs 7x7), batch size, remat, and the BatchNorm backward
+(custom-VJP fused vs plain autodiff); prints one line per config and a
+final ranking.  Use TFOS_SWEEP=b256_s2d_bnf,b512_s2d_bnf,... to subset
+by the names in CONFIGS below.
 
 Usage: python scripts/sweep_resnet.py [--steps 10]
 """
@@ -17,16 +19,20 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
 
-# (name, batch, stem_s2d, remat) — most promising first, so a flaky
-# tunnel session still yields the configs that matter.  Module-level so
-# dry-run tests can substitute tiny shapes while driving the REAL
-# sweep/promote/refusal paths.
+# (name, batch, stem_s2d, remat, bn_fused) — most promising first, so a
+# flaky tunnel session still yields the configs that matter.  Module-level
+# so dry-run tests can substitute tiny shapes while driving the REAL
+# sweep/promote/refusal paths.  bn_fused: custom-VJP BatchNorm backward
+# (two fused HBM passes; see models/layers._bn_train_fused) vs plain
+# autodiff — the round-4 profile showed ~38% of the step in unfused BN
+# backward multiplies.
 CONFIGS = [
-    ("b512_s2d", 512, True, False),
-    ("b256_s2d", 256, True, False),
-    ("b512_s2d_remat", 512, True, True),
-    ("b1024_s2d_remat", 1024, True, True),
-    ("b256_7x7", 256, False, False),
+    ("b256_s2d_bnf", 256, True, False, True),
+    ("b512_s2d_bnf", 512, True, False, True),
+    ("b384_s2d_bnf", 384, True, False, True),
+    ("b256_s2d", 256, True, False, False),
+    ("b512_s2d_remat_bnf", 512, True, True, True),
+    ("b256_7x7_bnf", 256, False, False, True),
 ]
 
 
@@ -103,12 +109,12 @@ def main():
     # dry-run tests can drive the real promote/merge/refusal branches.
     if os.environ.get("TFOS_SWEEP_SMOKE") == "1" \
             or os.environ.get("TFOS_SWEEP_TINY") == "1":
-        configs = [(n, 4, s, r) for n, _, s, r in configs[:2]]
+        configs = [(n, 4, s, r, bf) for n, _, s, r, bf in configs[:2]]
 
     rng = np.random.default_rng(0)
     results = []
     by_name = {}
-    for name, batch, s2d, remat in configs:
+    for name, batch, s2d, remat, bnf in configs:
         try:
             import jax.numpy as jnp
 
@@ -117,7 +123,7 @@ def main():
                            dtype=np.float32), jnp.bfloat16)
             labels = jnp.asarray(rng.integers(0, 1000, batch), jnp.int32)
             step_fn = resnet.make_train_step(
-                opt, depth=50, stem_s2d=s2d, remat=remat)
+                opt, depth=50, stem_s2d=s2d, remat=remat, bn_fused=bnf)
             sec, compile_s = measure(
                 step_fn, params, state, opt_state, images, labels, args.steps)
             ips = batch / sec
@@ -125,7 +131,8 @@ def main():
             print(f"{name:18s} step={sec*1e3:7.1f}ms  img/s={ips:7.0f}  "
                   f"mfu={mfu:.4f}  (compile {compile_s:.0f}s)", flush=True)
             results.append((mfu, name))
-            by_name[name] = {"batch": batch, "stem_s2d": s2d, "remat": remat}
+            by_name[name] = {"batch": batch, "stem_s2d": s2d, "remat": remat,
+                             "bn_fused": bnf}
         except Exception as e:  # noqa: BLE001 - keep sweeping
             print(f"{name:18s} FAILED: {str(e)[:160]}", flush=True)
     for mfu, name in sorted(results, reverse=True):

@@ -13,6 +13,8 @@ TPU-first choices:
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import optax
@@ -61,27 +63,28 @@ def _block_init(key, kind, in_ch, ch, stride, dtype):
     return p, s, out_ch
 
 
-def _block_apply(p, s, x, kind, stride, train):
+def _block_apply(p, s, x, kind, stride, train, bn_fused=True):
     ns = {}
+    bn = functools.partial(L.batchnorm, train=train, fused=bn_fused)
     shortcut = x
     if "proj" in p:
         shortcut = L.conv(p["proj"], x, stride=stride)
-        shortcut, ns["bn_proj"] = L.batchnorm(p["bn_proj"], s["bn_proj"], shortcut, train)
+        shortcut, ns["bn_proj"] = bn(p["bn_proj"], s["bn_proj"], shortcut)
     if kind == "bottleneck":
         y = L.conv(p["conv1"], x)
-        y, ns["bn1"] = L.batchnorm(p["bn1"], s["bn1"], y, train)
+        y, ns["bn1"] = bn(p["bn1"], s["bn1"], y)
         y = L.relu(y)
         y = L.conv(p["conv2"], y, stride=stride)
-        y, ns["bn2"] = L.batchnorm(p["bn2"], s["bn2"], y, train)
+        y, ns["bn2"] = bn(p["bn2"], s["bn2"], y)
         y = L.relu(y)
         y = L.conv(p["conv3"], y)
-        y, ns["bn3"] = L.batchnorm(p["bn3"], s["bn3"], y, train)
+        y, ns["bn3"] = bn(p["bn3"], s["bn3"], y)
     else:
         y = L.conv(p["conv1"], x, stride=stride)
-        y, ns["bn1"] = L.batchnorm(p["bn1"], s["bn1"], y, train)
+        y, ns["bn1"] = bn(p["bn1"], s["bn1"], y)
         y = L.relu(y)
         y = L.conv(p["conv2"], y)
-        y, ns["bn2"] = L.batchnorm(p["bn2"], s["bn2"], y, train)
+        y, ns["bn2"] = bn(p["bn2"], s["bn2"], y)
     return L.relu(y + shortcut), ns
 
 
@@ -138,7 +141,7 @@ def _stem_space_to_depth(w7, x):
 
 
 def apply(params, state, images, depth=50, train=True, small_inputs=False,
-          compute_dtype=jnp.bfloat16, stem_s2d=True):
+          compute_dtype=jnp.bfloat16, stem_s2d=True, bn_fused=True):
     """images [N,H,W,3] → logits [N,num_classes]; returns (logits, new_state)."""
     kind, counts = _PLANS[depth]
     x = images.astype(compute_dtype)
@@ -149,7 +152,8 @@ def apply(params, state, images, depth=50, train=True, small_inputs=False,
         x = _stem_space_to_depth(params["stem"]["w"], x)
     else:
         x = L.conv(params["stem"], x, stride=2)
-    x, new_state["bn_stem"] = L.batchnorm(params["bn_stem"], state["bn_stem"], x, train)
+    x, new_state["bn_stem"] = L.batchnorm(params["bn_stem"], state["bn_stem"],
+                                          x, train, fused=bn_fused)
     x = L.relu(x)
     if not small_inputs:
         # SAME padding: 112 -> 56 (the standard ResNet stem; VALID's 55
@@ -160,7 +164,7 @@ def apply(params, state, images, depth=50, train=True, small_inputs=False,
             stride = 2 if (b == 0 and stage > 0) else 1
             name = f"s{stage}b{b}"
             x, new_state[name] = _block_apply(
-                params[name], state[name], x, kind, stride, train
+                params[name], state[name], x, kind, stride, train, bn_fused
             )
     x = L.avg_pool_global(x).astype(jnp.float32)
     return L.dense(params["fc"], x), new_state
@@ -168,7 +172,7 @@ def apply(params, state, images, depth=50, train=True, small_inputs=False,
 
 def make_train_step(optimizer, depth=50, small_inputs=False,
                     compute_dtype=jnp.bfloat16, remat=False, stem_s2d=True,
-                    accum_steps=1):
+                    accum_steps=1, bn_fused=True):
     """(params, state, opt_state, images, labels) →
     (params, state, opt_state, loss, acc); jittable, SPMD-ready.
 
@@ -183,12 +187,12 @@ def make_train_step(optimizer, depth=50, small_inputs=False,
 
     fwd = apply
     if remat:
-        fwd = jax.checkpoint(apply, static_argnums=(3, 4, 5, 6, 7))
+        fwd = jax.checkpoint(apply, static_argnums=(3, 4, 5, 6, 7, 8))
 
     def loss_fn(params, state, images, labels):
         logits, new_state = fwd(
             params, state, images, depth, True, small_inputs, compute_dtype,
-            stem_s2d
+            stem_s2d, bn_fused
         )
         return L.softmax_cross_entropy(logits, labels), (logits, new_state)
 

@@ -52,10 +52,10 @@ def test_resnet_promote_writes_config_on_faked_tpu(tmp_path):
     out = _run(
         [DRIVER, "sweep_resnet", "faketpu",
          "--steps", "2", "--image", "32", "--promote"],
-        _env(cfg, TFOS_SWEEP="b512_s2d"))
+        _env(cfg, TFOS_SWEEP="b512_s2d_bnf"))
     assert "promoted" in out, out
     written = json.loads(cfg.read_text())
-    assert written["winner"] == "b512_s2d"
+    assert written["winner"] == "b512_s2d_bnf"
     assert written["batch"] == 4 and written["image"] == 32
     assert written["stem_s2d"] is True
     assert "FakeTpuDevice" in written["device"]
@@ -66,14 +66,14 @@ def test_transformer_promote_merges_resnet_section(tmp_path):
     # pre-existing resnet winner must survive the transformer promote
     cfg.write_text(json.dumps(
         {"batch": 512, "stem_s2d": True, "remat": False,
-         "winner": "b512_s2d", "image": 224}))
+         "winner": "b512_s2d_bnf", "image": 224}))
     out = _run(
         [DRIVER, "sweep_transformer", "faketpu",
          "--steps", "2", "--promote"],
         _env(cfg, TFOS_SWEEP="b16_q512_kv512"))
     assert "promoted" in out, out
     written = json.loads(cfg.read_text())
-    assert written["winner"] == "b512_s2d"  # resnet section kept
+    assert written["winner"] == "b512_s2d_bnf"  # resnet section kept
     assert written["transformer"]["winner"] == "b16_q512_kv512"
     assert written["transformer"]["bwd"] == "xla"
 
@@ -85,7 +85,7 @@ def test_promote_refused_on_real_cpu(tmp_path):
     out = _run(
         [DRIVER, "sweep_resnet", "cpu",
          "--steps", "2", "--image", "32", "--promote"],
-        _env(cfg, TFOS_SWEEP="b512_s2d"))
+        _env(cfg, TFOS_SWEEP="b512_s2d_bnf"))
     assert "promote skipped" in out, out
     assert not cfg.exists()
 
@@ -95,7 +95,7 @@ def test_tiny_promote_refused_without_acknowledgement(tmp_path):
     pin bench_config.json to batch-4 toy shapes: promote requires the
     explicit TFOS_SWEEP_TINY_PROMOTE_OK acknowledgement."""
     cfg = tmp_path / "bench_config.json"
-    env = _env(cfg, TFOS_SWEEP="b512_s2d")
+    env = _env(cfg, TFOS_SWEEP="b512_s2d_bnf")
     env.pop("TFOS_SWEEP_TINY_PROMOTE_OK")
     out = _run(
         [DRIVER, "sweep_resnet", "faketpu",
@@ -148,7 +148,7 @@ def test_full_session_smoke(tmp_path):
                TFOS_SESSION_TRANSFORMER_STEPS="2",
                TFOS_SESSION_BREAKDOWN=str(breakdown),
                TFOS_PERF_LOG=str(log),
-               TFOS_SWEEP="b512_s2d,b16_q512_kv512")
+               TFOS_SWEEP="b512_s2d_bnf,b16_q512_kv512")
     proc = subprocess.run(
         ["bash", os.path.join(REPO, "scripts", "tpu_perf_session.sh")],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
