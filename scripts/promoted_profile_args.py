@@ -20,7 +20,9 @@ def main():
         args += ["--stem", "7x7"]
     if cfg.get("remat"):
         args += ["--remat"]
-    if not cfg.get("bn_fused", True):
+    if not cfg.get("bn_fused", False):
+        # absent key = the promoted winner was measured with plain BN (or
+        # never measured): profiling must not debut the fused graph on TPU
         args += ["--bn", "plain"]
     print(" ".join(args))
 

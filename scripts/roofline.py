@@ -1,0 +1,125 @@
+"""Measure this chip's achievable roofline: big-matmul TFLOP/s (MXU
+ceiling) and big-elementwise + reduction GB/s (HBM ceiling).
+
+Grounds MFU analysis in measured hardware numbers instead of datasheet
+peaks: ResNet-50's step is HBM-bound (PERF.md round 4), so its MFU
+ceiling is set by measured bandwidth, not the 197 TFLOP/s MXU figure.
+
+Usage: python scripts/roofline.py [--out ROOFLINE.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _timed(fn, *args, iters=8):
+    out = fn(*args)
+    out.block_until_ready()  # compile + warmup
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    out.block_until_ready()
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None, help="also write JSON here")
+    ap.add_argument("--iters", type=int, default=8)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    dev = jax.devices()[0]
+    print(f"device: {dev} ({getattr(dev, 'device_kind', '?')})", flush=True)
+    small = dev.platform == "cpu"
+    report = {"device": str(dev), "platform": dev.platform}
+
+    # -- MXU ceiling: bf16 matmul chain, K large enough to amortize -----
+    m = 2048 if small else 8192
+    k = n = m
+    steps = 4
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (m, k), jnp.bfloat16)
+    b = jax.random.normal(key, (k, n), jnp.bfloat16)
+
+    @jax.jit
+    def mm(a, b):
+        # chain keeps the MXU busy across `steps` matmuls in ONE program
+        def body(x, _):
+            return jnp.dot(x, b, preferred_element_type=jnp.bfloat16), None
+        y, _ = lax.scan(body, a, None, length=steps)
+        return y
+
+    dt = _timed(mm, a, b, iters=args.iters)
+    tflops = 2.0 * m * k * n * steps / dt / 1e12
+    report["matmul_bf16_tflops"] = round(tflops, 1)
+    print(f"bf16 matmul ({m}x{k}x{n} x{steps}): {tflops:.1f} TFLOP/s",
+          flush=True)
+
+    # -- HBM ceiling 1: elementwise copy-scale (read + write) -----------
+    nelem = (1 << 24) if small else (1 << 29)  # 1 GiB bf16 on TPU
+    x = jax.random.normal(key, (nelem,), jnp.bfloat16)
+
+    @jax.jit
+    def ew(x):
+        def body(y, _):
+            return y * jnp.bfloat16(1.0001) + jnp.bfloat16(1e-6), None
+        y, _ = lax.scan(body, x, None, length=steps)
+        return y
+
+    dt = _timed(ew, x, iters=args.iters)
+    gbs_ew = 2 * 2 * nelem * steps / dt / 1e9  # read + write, 2B/elem
+    report["elementwise_gbs"] = round(gbs_ew, 1)
+    print(f"elementwise r+w: {gbs_ew:.1f} GB/s", flush=True)
+
+    # -- HBM ceiling 2: reduction (read-only traffic) -------------------
+    @jax.jit
+    def red(x):
+        xf = x.astype(jnp.float32)
+        return jnp.sum(xf) + jnp.sum(xf * xf)
+
+    dt = _timed(red, x, iters=args.iters)
+    gbs_red = 2 * nelem / dt / 1e9
+    report["reduce_gbs"] = round(gbs_red, 1)
+    print(f"one-pass double reduce: {gbs_red:.1f} GB/s", flush=True)
+
+    # -- BN-shaped op: the ResNet hot pattern at its real shape ---------
+    bshape = (64, 56, 56, 256) if not small else (8, 16, 16, 32)
+    xb = jax.random.normal(key, bshape, jnp.bfloat16)
+
+    @jax.jit
+    def bnlike(x):
+        xf = x.astype(jnp.float32)
+        ax = (0, 1, 2)
+        nred = x.size // x.shape[-1]
+        mean = jnp.sum(xf, axis=ax) / nred
+        var = jnp.maximum(jnp.sum(xf * xf, axis=ax) / nred - mean * mean, 0)
+        mul = lax.rsqrt(var + 1e-5).astype(x.dtype)
+        add = (-mean * lax.rsqrt(var + 1e-5)).astype(x.dtype)
+        return x * mul + add
+
+    dt = _timed(bnlike, xb, iters=args.iters)
+    nb = np.prod(bshape)
+    gbs_bn = 2 * (2 * nb + nb) / dt / 1e9  # stats read + norm read + write
+    report["bn_fwd_gbs"] = round(gbs_bn, 1)
+    print(f"bn-shaped fwd (stats+normalize, {bshape}): {gbs_bn:.1f} GB/s "
+          f"effective", flush=True)
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+        print(f"wrote {args.out}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

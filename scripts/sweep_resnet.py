@@ -153,14 +153,22 @@ def main():
         best_mfu, best = max(results)
         path = config_path()
         cfg_all = {}
+        prior_mfu, prior_winner = 0, None
         if os.path.exists(path):  # keep other sections (e.g. transformer)
             try:
                 with open(path) as f:
                     prior = json.load(f)
+                prior_mfu = prior.get("mfu", 0) or 0
+                prior_winner = prior.get("winner")
                 cfg_all = {k: v for k, v in prior.items()
                            if isinstance(v, dict)}  # nested sections only
             except (OSError, ValueError):
                 cfg_all = {}
+        if prior_mfu > best_mfu:
+            # a subset re-sweep must not demote a better earlier winner
+            print(f"promote kept prior {prior_winner} "
+                  f"(mfu {prior_mfu:.4f} > {best_mfu:.4f})", flush=True)
+            return
         cfg_all.update(by_name[best], image=args.image, winner=best,
                        mfu=round(best_mfu, 4), device=str(dev))
         with open(path, "w") as f:

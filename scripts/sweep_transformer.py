@@ -34,6 +34,14 @@ CONFIGS = [
     ("b64_q512_kv512_bce", 64, 512, 512, False, "xla", "block"),
     ("b64_q512_kv512_remat", 64, 512, 512, True, "xla", "dense"),
     ("b64_q512_kv512_remat_bce", 64, 512, 512, True, "xla", "block"),
+    # r4 follow-ups around the first chip session's winner
+    # (b32_q512_kv512_remat_pbwd, 0.4826): pallas bwd at other
+    # batch/block points, and pallas bwd + blockwise CE together
+    ("b64_q512_kv512_remat_pbwd", 64, 512, 512, True, "pallas", "dense"),
+    ("b32_q1024_kv1024_remat_pbwd", 32, 1024, 1024, True, "pallas", "dense"),
+    ("b64_q512_kv512_remat_pbwd_bce", 64, 512, 512, True, "pallas", "block"),
+    ("b32_q512_kv512_remat_pbwd_bce", 32, 512, 512, True, "pallas", "block"),
+    ("b16_q512_kv512_remat_pbwd", 16, 512, 512, True, "pallas", "dense"),
 ]
 
 
@@ -230,6 +238,12 @@ def main():
                     cfg_all = json.load(f)
             except (OSError, ValueError):
                 cfg_all = {}
+        prior = cfg_all.get("transformer", {})
+        if isinstance(prior, dict) and prior.get("mfu", 0) > best_mfu:
+            # a subset re-sweep must not demote a better earlier winner
+            print(f"promote kept prior {prior.get('winner')} "
+                  f"(mfu {prior['mfu']:.4f} > {best_mfu:.4f})", flush=True)
+            return
         cfg_all["transformer"] = dict(
             by_name[best], winner=best, mfu=round(best_mfu, 4))
         with open(path, "w") as f:

@@ -1,0 +1,47 @@
+#!/bin/bash
+# Round-4 follow-up chip session: everything the first session's death
+# left unmeasured, most valuable first.  Probe-gated like
+# tpu_perf_session.sh; each step its own process (serialized claims).
+#
+#   1. ResNet sweep over the fused-BN configs, promote
+#   2. Re-profile the (possibly new) winner -> PERF_BREAKDOWN.md
+#   3. Transformer follow-up subset (pallas-bwd variants), promote
+#   4. Roofline probe -> ROOFLINE.json (measured MXU + HBM ceilings)
+#   5. bench.py -> the round's JSON line with promoted configs
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+log=${TFOS_PERF_LOG:-perf_followup_r4.log}
+echo "== r4 follow-up session $(date -u +%FT%TZ) ==" | tee -a "$log"
+
+export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/tfos_xla_cache}
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+
+run() {
+  echo "-- $* --" | tee -a "$log"
+  "$@" 2>&1 | tee -a "$log"
+  echo "-- rc=$? --" | tee -a "$log"
+}
+
+echo "-- tpu_probe --" | tee -a "$log"
+timeout "${TFOS_SESSION_PROBE_TIMEOUT:-300}" python scripts/tpu_probe.py 2>&1 | tee -a "$log"
+probe_rc=${PIPESTATUS[0]}
+echo "-- rc=$probe_rc --" | tee -a "$log"
+if [ "$probe_rc" != "0" ]; then
+  echo "ABORT: TPU probe failed (rc=$probe_rc) - tunnel/pool sick" | tee -a "$log"
+  exit "$probe_rc"
+fi
+
+# per-config timeout: the first session lost 47 min to a compile request
+# against a dying helper; timeout the WHOLE step rather than wedge
+TFOS_SWEEP=b256_s2d_bnf,b512_s2d_bnf,b384_s2d_bnf \
+  run timeout 7200 python scripts/sweep_resnet.py --steps 20 --image 224 --promote
+run timeout 3600 python scripts/profile_resnet.py --out PERF_BREAKDOWN.md \
+    --steps 10 --image 224 $(python scripts/promoted_profile_args.py)
+TFOS_SWEEP=b64_q512_kv512_remat_pbwd,b32_q1024_kv1024_remat_pbwd,b64_q512_kv512_remat_pbwd_bce,b32_q512_kv512_remat_pbwd_bce \
+  run timeout 7200 python scripts/sweep_transformer.py --steps 8 --promote
+run timeout 1800 python scripts/roofline.py --out ROOFLINE.json
+run timeout 7200 python bench.py
+
+echo "== done; promoted config: ==" | tee -a "$log"
+cat "${TFOS_BENCH_CONFIG:-bench_config.json}" 2>/dev/null | tee -a "$log" || true

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Poll the axon relay port; the moment it opens, fire the given command
+# (default: the round-4 follow-up session).  Round-3 lesson: a tunnel
+# that comes back mid-session must never be missed.
+#   bash scripts/watch_tunnel.sh [cmd...]
+set -u
+cd "$(dirname "$0")/.."
+cmd=("${@:-}")
+if [ -z "${cmd[0]:-}" ]; then cmd=(bash scripts/tpu_round4_followup.sh); fi
+echo "watching port 8082 for the tunnel; will run: ${cmd[*]}"
+while true; do
+  if timeout 2 bash -c "echo > /dev/tcp/127.0.0.1/8082" 2>/dev/null; then
+    echo "tunnel OPEN at $(date -u +%FT%TZ); firing"
+    "${cmd[@]}"
+    exit $?
+  fi
+  sleep 30
+done

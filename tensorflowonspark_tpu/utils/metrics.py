@@ -46,13 +46,22 @@ def peak_flops(device=None):
     return None  # unknown (CPU): MFU not reported
 
 
-def transformer_flops_per_token(cfg):
-    """~6N FLOPs/token (fwd+bwd) + attention term, from the config."""
+def transformer_flops_per_token(cfg, causal=False):
+    """~6N FLOPs/token (fwd+bwd) + attention term, from the config.
+
+    Default is the PaLM appendix-B convention: the attention matmuls are
+    counted dense (12·L·d·S per token) even for causal models — the
+    convention most published MFU numbers use.  ``causal=True`` halves
+    the attention term to count only the algorithmically required work,
+    the honest denominator for kernels that skip the non-causal half
+    (e.g. the pallas flash path with causal block skipping)."""
     n_params = (
         cfg.vocab_size * cfg.dim * 2
         + cfg.n_layers * (cfg.dim * cfg.dim * 4 + cfg.dim * cfg.dim * cfg.mlp_ratio * 2)
     )
     attn = 12 * cfg.n_layers * cfg.dim * cfg.max_seq  # 2*2*3 * L * d * S
+    if causal:
+        attn //= 2
     return 6 * n_params + attn
 
 
