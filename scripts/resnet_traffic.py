@@ -1,0 +1,174 @@
+"""Analytic HBM-traffic floor for a ResNet train step → achievable MFU.
+
+The measured ResNet-50 step is HBM-bound (PERF.md round 4), so the honest
+performance ceiling is set by unavoidable memory traffic, not the MXU
+datasheet.  This walks the real stage plan from models/resnet.py and
+counts, per conv, the traffic an *ideally fused* training step must move:
+
+  fwd:        read x, read w, write y            (BN/ReLU fused for free)
+  bwd-data:   read dy, read w, write dx
+  bwd-filter: read dy, read x, write dw
+
+i.e. 3*(|x|+|y|) activation bytes + 3*|w| weight bytes per conv, in the
+compute dtype.  Dividing by a measured elementwise bandwidth (from
+scripts/roofline.py → ROOFLINE.json) gives a lower-bound step time and
+therefore an upper bound on achievable MFU for this model shape — the
+number `resnet50_train_mfu` should be judged against, alongside the
+datasheet-peak MFU.
+
+Usage:
+  python scripts/resnet_traffic.py [--batch 256] [--image 224]
+      [--roofline ROOFLINE.json] [--step-ms 99.2] [--out TRAFFIC.json]
+
+Pure host-side arithmetic — no jax, safe anywhere.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# (block kind, per-stage counts) — mirror models/resnet.py _PLANS
+_PLANS = {
+    18: ("basic", (2, 2, 2, 2)),
+    34: ("basic", (3, 4, 6, 3)),
+    50: ("bottleneck", (3, 4, 6, 3)),
+    101: ("bottleneck", (3, 4, 23, 3)),
+    152: ("bottleneck", (3, 8, 36, 3)),
+}
+
+
+def conv_cost(n, h_in, w_in, c_in, c_out, k, stride, bytes_per):
+    """Returns (act_bytes, weight_bytes, flops) for one conv in the
+    ideally-fused train step (see module docstring)."""
+    h_out, w_out = h_in // stride, w_in // stride
+    x = n * h_in * w_in * c_in
+    y = n * h_out * w_out * c_out
+    w = k * k * c_in * c_out
+    act_bytes = 3 * (x + y) * bytes_per
+    weight_bytes = 3 * w * bytes_per
+    # fwd MACs*2; train = fwd + dgrad + wgrad = 3x
+    flops = 3 * 2 * n * h_out * w_out * k * k * c_in * c_out
+    return act_bytes, weight_bytes, flops, (h_out, w_out)
+
+
+def resnet_traffic(depth=50, batch=256, image=224, width=64, bytes_per=2,
+                   stem_s2d=True):
+    kind, counts = _PLANS[depth]
+    total_act = total_w = total_flops = 0
+    n = batch
+
+    def add(r):
+        nonlocal total_act, total_w, total_flops
+        a, w, f, hw = r
+        total_act += a
+        total_w += w
+        total_flops += f
+        return hw
+
+    # stem: 7x7/s2 (or the exact-equivalent 4x4/s1 over 2x2 s2d input —
+    # same output, slightly different input traffic; use s2d's)
+    if stem_s2d:
+        hw = add(conv_cost(n, image // 2, image // 2, 12, width, 4, 1,
+                           bytes_per))
+    else:
+        hw = add(conv_cost(n, image, image, 3, width, 7, 2, bytes_per))
+    h, w_ = hw[0] // 2, hw[1] // 2  # 3x3/s2 maxpool
+    in_ch = width
+    for stage, nblocks in enumerate(counts):
+        ch = width * (2 ** stage)
+        for b in range(nblocks):
+            stride = 2 if (b == 0 and stage > 0) else 1
+            if kind == "bottleneck":
+                out_ch = ch * 4
+                add(conv_cost(n, h, w_, in_ch, ch, 1, 1, bytes_per))
+                hw = add(conv_cost(n, h, w_, ch, ch, 3, stride, bytes_per))
+                add(conv_cost(n, hw[0], hw[1], ch, out_ch, 1, 1, bytes_per))
+            else:
+                out_ch = ch
+                hw = add(conv_cost(n, h, w_, in_ch, ch, 3, stride, bytes_per))
+                add(conv_cost(n, hw[0], hw[1], ch, ch, 3, 1, bytes_per))
+            if stride != 1 or in_ch != out_ch:
+                add(conv_cost(n, h, w_, in_ch, out_ch, 1, stride, bytes_per))
+            h, w_ = hw
+            in_ch = out_ch
+    return {"act_bytes": total_act, "weight_bytes": total_w,
+            "train_flops": total_flops}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--depth", type=int, default=50)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--image", type=int, default=224)
+    ap.add_argument("--roofline", default="ROOFLINE.json")
+    ap.add_argument("--peak-tflops", type=float, default=197.0)
+    ap.add_argument("--step-ms", type=float, default=None,
+                    help="measured step time to score against the floor")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    t = resnet_traffic(args.depth, args.batch, args.image)
+    gb = (t["act_bytes"] + t["weight_bytes"]) / 1e9
+
+    hbm_gbs = None
+    mxu_tflops = args.peak_tflops
+    if os.path.exists(args.roofline):
+        with open(args.roofline) as f:
+            roof = json.load(f)
+        # refuse numbers roofline.py marked timing-compromised, or that
+        # exceed the physics limits roofline stamped into the report
+        # (legacy files without a stamp get v5e-class defaults — the
+        # source of truth is roofline.physics_limits)
+        max_gbs = roof.get("sanity_max_gbs", 1600)
+        max_tflops = roof.get("sanity_max_tflops", 400)
+        if roof.get("suspect"):
+            print(f"ignoring {args.roofline}: marked suspect "
+                  f"{roof['suspect']} (timing path compromised)")
+        elif roof.get("elementwise_gbs", 0) > max_gbs \
+                or roof.get("matmul_bf16_tflops", 0) > max_tflops:
+            print(f"ignoring {args.roofline}: values exceed datasheet "
+                  f"physics (stale dispatch-artifact measurement)")
+        else:
+            hbm_gbs = roof.get("elementwise_gbs")
+            mxu_tflops = roof.get("matmul_bf16_tflops", args.peak_tflops)
+
+    report = {"depth": args.depth, "batch": args.batch, "image": args.image,
+              "min_hbm_gb_per_step": round(gb, 3),
+              "train_tflops_per_step": round(t["train_flops"] / 1e12, 3)}
+    print(f"ResNet-{args.depth} b{args.batch} im{args.image}: "
+          f"minimum {gb:.2f} GB/step, {t['train_flops']/1e12:.2f} TFLOP/step")
+
+    if hbm_gbs:
+        floor_ms = gb / hbm_gbs * 1e3
+        mxu_ms = t["train_flops"] / (mxu_tflops * 1e12) * 1e3
+        bound_ms = max(floor_ms, mxu_ms)
+        mfu_ceiling = (t["train_flops"] / (args.peak_tflops * 1e12)) \
+            / (bound_ms / 1e3)
+        report.update({
+            "hbm_gbs_measured": hbm_gbs,
+            "hbm_floor_ms": round(floor_ms, 1),
+            "mxu_floor_ms": round(mxu_ms, 1),
+            "bound": "hbm" if floor_ms > mxu_ms else "mxu",
+            "achievable_mfu_ceiling": round(mfu_ceiling, 4),
+        })
+        print(f"floors: HBM {floor_ms:.1f} ms (at measured {hbm_gbs} GB/s), "
+              f"MXU {mxu_ms:.1f} ms (at measured {mxu_tflops} TFLOP/s)")
+        print(f"achievable MFU ceiling (vs {args.peak_tflops} TFLOP/s "
+              f"datasheet): {mfu_ceiling:.3f}")
+        if args.step_ms:
+            report["step_ms"] = args.step_ms
+            report["pct_of_roofline"] = round(bound_ms / args.step_ms, 3)
+            print(f"measured {args.step_ms} ms -> "
+                  f"{100 * bound_ms / args.step_ms:.0f}% of roofline")
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+        print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

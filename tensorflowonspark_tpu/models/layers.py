@@ -128,35 +128,85 @@ def _bn_train_fused_fwd(x, scale, bias, eps):
     return (x * mul + add, mean, var), (x, mean, inv, scale)
 
 
-def _bn_train_fused_bwd(eps, res, cts):
-    x, mean, inv, scale = res
-    g, mean_ct, var_ct = cts
+def _bn_bwd_core(gm, x, mean, inv, scale, mean_ct, var_ct):
+    """Shared two-pass BN backward given the (possibly relu-gated)
+    f32 cotangent ``gm``; returns (dx, dscale, dbias).
+
+    Pass 1 is one fused reduction over (gm, x); pass 2 is
+    dx = a·gm + b·x + c — γ·inv·(gm − Σgm/n − x̂·Σ(gm·x̂)/n) rearranged
+    so the whole thing is a single elementwise fusion.  The (mean, var)
+    output cotangents (zero in the training path — they only feed the
+    non-differentiated EMA state — but cheap to honor exactly) fold
+    into the same b/c vectors."""
     reduce_axes = tuple(range(x.ndim - 1))
     n = x.size // x.shape[-1]
-    gf = g.astype(jnp.float32)
     xf = x.astype(jnp.float32)
-    # single fused pass over (g, x): both reductions share the read
-    sum_g = jnp.sum(gf, axis=reduce_axes)
-    sum_gx = jnp.sum(gf * xf, axis=reduce_axes)
+    sum_g = jnp.sum(gm, axis=reduce_axes)
+    sum_gx = jnp.sum(gm * xf, axis=reduce_axes)
     sum_g_xhat = (sum_gx - mean * sum_g) * inv
     sf = scale.astype(jnp.float32)
-    dscale = sum_g_xhat
-    dbias = sum_g
-    # dx = γ·inv·(g − Σg/n − x̂·Σ(g·x̂)/n) rearranged to a·g + b·x + c so
-    # the whole thing is one elementwise fusion over (g, x)
     a = sf * inv
     b = -sf * inv * inv * sum_g_xhat / n
     c = -a * sum_g / n - b * mean
-    # cotangents for the (mean, var) outputs (zero in the training path —
-    # they only feed the non-differentiated EMA state — but cheap to
-    # honor exactly: they fold into the same b/c vectors)
     b = b + 2.0 * var_ct / n
     c = c + (mean_ct - 2.0 * var_ct * mean) / n
-    dx = (a * gf + b * xf + c).astype(x.dtype)
-    return dx, dscale.astype(scale.dtype), dbias.astype(scale.dtype)
+    dx = (a * gm + b * xf + c).astype(x.dtype)
+    return dx, sum_g_xhat.astype(scale.dtype), sum_g.astype(scale.dtype)
+
+
+def _bn_train_fused_bwd(eps, res, cts):
+    x, mean, inv, scale = res
+    g, mean_ct, var_ct = cts
+    return _bn_bwd_core(g.astype(jnp.float32), x, mean, inv, scale,
+                        mean_ct, var_ct)
 
 
 _bn_train_fused.defvjp(_bn_train_fused_fwd, _bn_train_fused_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _bn_relu_train_fused(x, scale, bias, eps):
+    """BN→ReLU pair with one custom VJP over both.
+
+    Autodiff stores two activation-sized residuals per pair (x for the
+    BN backward, the pre-activation for the relu gate).  Here only x is
+    saved; the backward recomputes the gate from x and the per-channel
+    (mean, inv, scale, bias) vectors inside its existing passes — one
+    fewer activation HBM round-trip per BN→ReLU, on top of the fused-BN
+    backward's two-pass structure (see ``_bn_train_fused``).
+    """
+    mean, var, inv = _bn_stats(x, eps)
+    mul, add = _bn_scale_bias(mean, inv, scale, bias, x.dtype)
+    return jnp.maximum(x * mul + add, 0), mean, var
+
+
+def _bn_relu_train_fused_fwd(x, scale, bias, eps):
+    mean, var, inv = _bn_stats(x, eps)
+    mul, add = _bn_scale_bias(mean, inv, scale, bias, x.dtype)
+    y = jnp.maximum(x * mul + add, 0)
+    return (y, mean, var), (x, mean, inv, scale, bias)
+
+
+def _bn_relu_train_fused_bwd(eps, res, cts):
+    x, mean, inv, scale, bias = res
+    g, mean_ct, var_ct = cts
+    # recompute the pre-activation exactly as the forward did (same ops,
+    # same dtype) instead of storing it; sign() reproduces jnp.maximum's
+    # tie convention (gradient 1/2 where the pre-activation is exactly 0)
+    mul, add = _bn_scale_bias(mean, inv, scale, bias, x.dtype)
+    gate = (jnp.sign((x * mul + add).astype(jnp.float32)) + 1.0) * 0.5
+    return _bn_bwd_core(g.astype(jnp.float32) * gate, x, mean, inv, scale,
+                        mean_ct, var_ct)
+
+
+_bn_relu_train_fused.defvjp(_bn_relu_train_fused_fwd, _bn_relu_train_fused_bwd)
+
+
+def _ema_state(state, mean, var, momentum):
+    return {
+        "mean": momentum * state["mean"] + (1 - momentum) * mean,
+        "var": momentum * state["var"] + (1 - momentum) * var,
+    }
 
 
 def batchnorm(params, state, x, train=True, momentum=0.9, eps=1e-5,
@@ -180,16 +230,27 @@ def batchnorm(params, state, x, train=True, momentum=0.9, eps=1e-5,
             mul, add = _bn_scale_bias(
                 mean, inv, params["scale"], params["bias"], x.dtype)
             y = x * mul + add
-        new = {
-            "mean": momentum * state["mean"] + (1 - momentum) * mean,
-            "var": momentum * state["var"] + (1 - momentum) * var,
-        }
-        return y, new
+        return y, _ema_state(state, mean, var, momentum)
     mean, var = state["mean"], state["var"]
     inv = lax.rsqrt(var + eps)
     mul, add = _bn_scale_bias(mean, inv, params["scale"], params["bias"],
                               x.dtype)
     return x * mul + add, state
+
+
+def batchnorm_relu(params, state, x, train=True, momentum=0.9, eps=1e-5,
+                   fused=True):
+    """BatchNorm followed by ReLU.  In fused training mode the pair
+    shares one custom VJP (``_bn_relu_train_fused``) that stores no
+    pre-activation residual; otherwise it is exactly
+    ``relu(batchnorm(...))``.  Returns (y, new_state)."""
+    if train and fused:
+        y, mean, var = _bn_relu_train_fused(
+            x, params["scale"], params["bias"], eps)
+        return y, _ema_state(state, mean, var, momentum)
+    y, new_state = batchnorm(params, state, x, train=train,
+                             momentum=momentum, eps=eps, fused=fused)
+    return relu(y), new_state
 
 
 def layernorm_init(dim, dtype=jnp.float32):

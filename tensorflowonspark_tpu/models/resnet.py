@@ -66,23 +66,23 @@ def _block_init(key, kind, in_ch, ch, stride, dtype):
 def _block_apply(p, s, x, kind, stride, train, bn_fused=True):
     ns = {}
     bn = functools.partial(L.batchnorm, train=train, fused=bn_fused)
+    # BN→ReLU pairs route through the combined custom VJP (no stored
+    # pre-activation residual) when bn_fused; see layers.batchnorm_relu
+    bnr = functools.partial(L.batchnorm_relu, train=train, fused=bn_fused)
     shortcut = x
     if "proj" in p:
         shortcut = L.conv(p["proj"], x, stride=stride)
         shortcut, ns["bn_proj"] = bn(p["bn_proj"], s["bn_proj"], shortcut)
     if kind == "bottleneck":
         y = L.conv(p["conv1"], x)
-        y, ns["bn1"] = bn(p["bn1"], s["bn1"], y)
-        y = L.relu(y)
+        y, ns["bn1"] = bnr(p["bn1"], s["bn1"], y)
         y = L.conv(p["conv2"], y, stride=stride)
-        y, ns["bn2"] = bn(p["bn2"], s["bn2"], y)
-        y = L.relu(y)
+        y, ns["bn2"] = bnr(p["bn2"], s["bn2"], y)
         y = L.conv(p["conv3"], y)
         y, ns["bn3"] = bn(p["bn3"], s["bn3"], y)
     else:
         y = L.conv(p["conv1"], x, stride=stride)
-        y, ns["bn1"] = bn(p["bn1"], s["bn1"], y)
-        y = L.relu(y)
+        y, ns["bn1"] = bnr(p["bn1"], s["bn1"], y)
         y = L.conv(p["conv2"], y)
         y, ns["bn2"] = bn(p["bn2"], s["bn2"], y)
     return L.relu(y + shortcut), ns
@@ -152,9 +152,8 @@ def apply(params, state, images, depth=50, train=True, small_inputs=False,
         x = _stem_space_to_depth(params["stem"]["w"], x)
     else:
         x = L.conv(params["stem"], x, stride=2)
-    x, new_state["bn_stem"] = L.batchnorm(params["bn_stem"], state["bn_stem"],
-                                          x, train, fused=bn_fused)
-    x = L.relu(x)
+    x, new_state["bn_stem"] = L.batchnorm_relu(
+        params["bn_stem"], state["bn_stem"], x, train, fused=bn_fused)
     if not small_inputs:
         # SAME padding: 112 -> 56 (the standard ResNet stem; VALID's 55
         # also breaks the TPU's (8,128) tiling on every stage-1 tensor)
@@ -229,14 +228,27 @@ def make_train_step(optimizer, depth=50, small_inputs=False,
 
 
 def flops_per_image(depth=50, image_size=224):
-    """Approximate forward-pass FLOPs per image (2*MACs), for MFU math."""
+    """Forward-pass FLOPs per image, 2 FLOPs per MAC — the standard MFU
+    convention (PaLM appendix B; same convention as
+    utils.metrics.transformer_flops_per_token).
+
+    The 224x224 table is multiply-accumulate counts (torchvision's
+    published GMacs; cross-checked shape-exactly by
+    scripts/resnet_traffic.py at 4.12 GMACs for depth 50), doubled here.
+    NOTE: before round 4 this function returned the MAC count mislabeled
+    as 2*MACs, so every earlier reported ResNet MFU (BENCH_r01–r03,
+    PERF.md history) undercounts by exactly 2x; step times and img/s
+    were always convention-free.  bench_config.json's stored resnet
+    "mfu" was rescaled in the same commit as this fix.
+    """
     if depth in (18, 34, 50, 101, 152):
-        # standard 224x224 figures
-        base = {18: 1.8e9, 34: 3.6e9, 50: 4.09e9, 101: 7.8e9, 152: 11.5e9}[depth]
+        # standard 224x224 multiply-accumulate counts
+        macs = {18: 1.81e9, 34: 3.66e9, 50: 4.09e9,
+                101: 7.8e9, 152: 11.5e9}[depth]
         ref = 224
     else:
-        # CIFAR 6n+2 family at 32x32 (2*MACs)
-        base = {20: 0.082e9, 32: 0.138e9, 44: 0.194e9,
-                56: 0.252e9, 110: 0.51e9}[depth]
+        # CIFAR 6n+2 family at 32x32 (these were already 2*MACs)
+        macs = {20: 0.041e9, 32: 0.069e9, 44: 0.097e9,
+                56: 0.126e9, 110: 0.255e9}[depth]
         ref = 32
-    return base * (image_size / ref) ** 2
+    return 2.0 * macs * (image_size / ref) ** 2

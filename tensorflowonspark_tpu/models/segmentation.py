@@ -137,10 +137,11 @@ def apply(params, state, x, train=False):
 
     for i, tap in enumerate(reversed(taps)):
         y = _upconv(params[f"up{i}"], y)
-        y, ns[f"bn_up{i}"] = L.batchnorm(
+        # fused BN→ReLU pair: no stored pre-activation residual in the
+        # backward (layers.batchnorm_relu)
+        y, ns[f"bn_up{i}"] = L.batchnorm_relu(
             params[f"bn_up{i}"], state[f"bn_up{i}"], y, train
         )
-        y = L.relu(y)
         y = jnp.concatenate([y, tap], axis=-1)
     logits = _upconv(params["head"], y)
     return logits, ns

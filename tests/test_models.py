@@ -172,6 +172,48 @@ def test_batchnorm_fused_vjp_parity():
     np.testing.assert_allclose(gf[1], gp[1], rtol=1e-5, atol=1e-5)
 
 
+def test_batchnorm_relu_fused_vjp_parity():
+    """The combined BN→ReLU custom VJP must match relu(batchnorm(...))
+    in value, running stats, and all gradients — including jnp.maximum's
+    1/2-subgradient convention where the pre-activation is exactly 0."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import layers as L
+
+    key = jax.random.PRNGKey(7)
+    x = jax.random.normal(key, (8, 6, 6, 16), jnp.float32) * 2.0 - 0.5
+    # scale=0 on some channels forces pre-activation == 0 everywhere
+    # there, exercising the tie path of the recomputed gate
+    params = {"scale": jnp.linspace(0.5, 2.0, 16).at[3].set(0.0).at[11].set(0.0),
+              "bias": jnp.linspace(-1.0, 1.0, 16).at[3].set(0.0).at[11].set(0.0)}
+    state = {"mean": jnp.zeros(16), "var": jnp.ones(16)}
+
+    def loss(p, x, fused):
+        y, new = L.batchnorm_relu(p, state, x, train=True, fused=fused)
+        return (jnp.sum(jnp.tanh(y)) + jnp.sum(new["mean"])
+                + jnp.sum(new["var"]))
+
+    y_f, new_f = L.batchnorm_relu(params, state, x, train=True, fused=True)
+    y_p, new_p = L.batchnorm_relu(params, state, x, train=True, fused=False)
+    np.testing.assert_allclose(y_f, y_p, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(new_f["mean"], new_p["mean"], rtol=1e-6)
+    np.testing.assert_allclose(new_f["var"], new_p["var"], rtol=1e-6)
+    assert float(jnp.min(y_f)) >= 0.0
+
+    gf = jax.grad(loss, argnums=(0, 1))(params, x, True)
+    gp = jax.grad(loss, argnums=(0, 1))(params, x, False)
+    np.testing.assert_allclose(gf[0]["scale"], gp[0]["scale"], rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(gf[0]["bias"], gp[0]["bias"], rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(gf[1], gp[1], rtol=1e-5, atol=1e-5)
+    # eval mode must be the plain path (identical either way)
+    ye, _ = L.batchnorm_relu(params, state, x, train=False, fused=True)
+    yep, _ = L.batchnorm_relu(params, state, x, train=False, fused=False)
+    np.testing.assert_allclose(ye, yep, rtol=0, atol=0)
+
+
 def test_batchnorm_fused_bf16_train_step_parity():
     """Full ResNet train step: fused-BN gradients track the autodiff path
     in bf16 within bf16 noise, and the step still learns."""
