@@ -614,7 +614,11 @@ def _transformer_bench(dev, on_tpu):
             dtype="float32", attn_impl="reference",
         )
         batch, steps = 2, 3
-    remat = bool(promoted.get("remat", False))
+    # bool or the selective policy name "dots" — pass through (int 1
+    # must coerce: `1 in (True,)` is True but `1 is True` is not)
+    remat = promoted.get("remat", False)
+    if remat != "dots":
+        remat = bool(remat)
     ce_impl = ("blockwise" if promoted.get("ce") == "block" else "dense")
     attn_fn = None
     if (promoted.get("block_q") or promoted.get("block_kv")) \
@@ -672,7 +676,7 @@ def _transformer_bench(dev, on_tpu):
         "batch": batch, "loss": loss,
     }
     if remat:
-        out["remat"] = True
+        out["remat"] = remat
     if ce_impl != "dense":
         out["ce"] = "block"  # same spelling as the promoted config
     if promoted:

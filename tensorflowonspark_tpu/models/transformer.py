@@ -165,6 +165,14 @@ def apply(params, tokens, cfg: Config, *, attn_fn=None,
     recomputes layer internals instead of keeping ~10·dim·B·S bytes per
     layer resident, trading ~30% more FLOPs for an O(L·B·S·dim) →
     O(B·S·dim) activation footprint (how the bigger sweep batches fit).
+    ``remat="dots"`` is the selective policy: every matmul output is
+    saved and only the cheap elementwise chain is recomputed (jax
+    dots_with_no_batch_dims_saveable — the attention einsums inside the
+    flash kernel are custom-VJP-opaque and unaffected).  A
+    save-only-attn-output policy was evaluated and rejected: the flash
+    custom-VJP's residuals (lse etc.) are not name-saveable, so its
+    forward re-runs on backward regardless — full remat cost plus extra
+    residency.
     """
     if positions is not None and attn_fn is None:
         # the default flash mask is causal by ARRAY INDEX; on permuted
@@ -195,8 +203,16 @@ def apply(params, tokens, cfg: Config, *, attn_fn=None,
 
     layer_fn = _layer_apply
     if remat:
+        if remat == "dots":
+            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        elif remat is True:
+            policy = None  # full remat
+        else:
+            raise ValueError(
+                f"remat must be bool or 'dots'; got {remat!r}")
         layer_fn = jax.checkpoint(
-            _layer_apply, static_argnums=(2, 4))  # cfg, attn_fn
+            _layer_apply, static_argnums=(2, 4),  # cfg, attn_fn
+            policy=policy)
 
     def body(x, layer_params):
         return layer_fn(layer_params, x, cfg, rope, attn_fn), None

@@ -187,11 +187,18 @@ def test_remat_matches_no_remat():
 
     base_loss, base_grads = jax.value_and_grad(transformer.loss_fn)(
         params, tokens, cfg)
-    r_loss, r_grads = jax.value_and_grad(
-        lambda p, t: transformer.loss_fn(p, t, cfg, remat=True))(
-        params, tokens)
-    np.testing.assert_allclose(float(base_loss), float(r_loss), rtol=1e-6)
-    jax.tree.map(
-        lambda a, b: np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
-        base_grads, r_grads)
+    # full remat plus both selective policies: loss and grads must be
+    # bit-compatible (policies only change residency, not math)
+    for mode in (True, "dots"):
+        r_loss, r_grads = jax.value_and_grad(
+            lambda p, t, m=mode: transformer.loss_fn(p, t, cfg, remat=m))(
+            params, tokens)
+        np.testing.assert_allclose(float(base_loss), float(r_loss),
+                                   rtol=1e-6, err_msg=f"remat={mode}")
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+            base_grads, r_grads)
+
+    with pytest.raises(ValueError, match="remat"):
+        transformer.loss_fn(params, tokens, cfg, remat="bogus")
