@@ -1,18 +1,22 @@
 #!/bin/bash
-# Round-4 follow-up chip session: everything the first session's death
-# left unmeasured, most valuable first.  Probe-gated like
-# tpu_perf_session.sh; each step its own process (serialized claims).
+# Round-4 follow-up chip session (v2, after the second relay death):
+# everything still unmeasured, cheapest-and-most-informative first.
+# Probe-gated like tpu_perf_session.sh; each step its own process
+# (serialized claims) wrapped in `timeout` (a compile request against a
+# dying helper once wedged 47 min).
 #
-#   1. ResNet sweep over the fused-BN configs, promote
-#   2. Re-profile the (possibly new) winner -> PERF_BREAKDOWN.md
-#   3. Transformer follow-up subset (pallas-bwd variants), promote
-#   4. Roofline probe -> ROOFLINE.json (measured MXU + HBM ceilings)
-#   5. bench.py -> the round's JSON line with promoted configs
+#   1. Roofline (chained-timing rewrite) -> ROOFLINE.json
+#   2. ResNet sweep over fused-BN(+ReLU) configs, promote
+#      (b256_s2d_bnf measured 99.2ms pre-bn_relu: direct A/B)
+#   3. Analytic traffic floor vs measured roofline -> TRAFFIC.json
+#   4. Re-profile the winner -> PERF_BREAKDOWN.md
+#   5. Transformer selective-remat subset (rdots/b96), promote
+#   6. bench.py -> the round's JSON line with promoted configs
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
 log=${TFOS_PERF_LOG:-perf_followup_r4.log}
-echo "== r4 follow-up session $(date -u +%FT%TZ) ==" | tee -a "$log"
+echo "== r4 follow-up session v2 $(date -u +%FT%TZ) ==" | tee -a "$log"
 
 export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/tfos_xla_cache}
 mkdir -p "$JAX_COMPILATION_CACHE_DIR"
@@ -32,15 +36,14 @@ if [ "$probe_rc" != "0" ]; then
   exit "$probe_rc"
 fi
 
-# per-config timeout: the first session lost 47 min to a compile request
-# against a dying helper; timeout the WHOLE step rather than wedge
-TFOS_SWEEP=b256_s2d_bnf,b512_s2d_bnf,b384_s2d_bnf \
+run timeout 1800 python scripts/roofline.py --out ROOFLINE.json
+TFOS_SWEEP=b256_s2d_bnf,b384_s2d_bnf,b256_s2d \
   run timeout 7200 python scripts/sweep_resnet.py --steps 20 --image 224 --promote
+run timeout 600 python scripts/resnet_traffic.py --batch 256 --out TRAFFIC.json
 run timeout 3600 python scripts/profile_resnet.py --out PERF_BREAKDOWN.md \
     --steps 10 --image 224 $(python scripts/promoted_profile_args.py)
-TFOS_SWEEP=b64_q512_kv512_remat_pbwd,b32_q1024_kv1024_remat_pbwd,b64_q512_kv512_remat_pbwd_bce,b32_q512_kv512_remat_pbwd_bce \
+TFOS_SWEEP=b64_q512_kv512_rdots_pbwd,b96_q512_kv512_rdots_pbwd,b96_q512_kv512_remat_pbwd \
   run timeout 7200 python scripts/sweep_transformer.py --steps 8 --promote
-run timeout 1800 python scripts/roofline.py --out ROOFLINE.json
 run timeout 7200 python bench.py
 
 echo "== done; promoted config: ==" | tee -a "$log"

@@ -202,6 +202,48 @@ def _bn_relu_train_fused_bwd(eps, res, cts):
 _bn_relu_train_fused.defvjp(_bn_relu_train_fused_fwd, _bn_relu_train_fused_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _bn_add_relu_train_fused(x, shortcut, scale, bias, eps):
+    """relu(bn(x) + shortcut) — the ResNet block-end pattern — as one
+    custom VJP.
+
+    Autodiff saves x (BN backward) plus the pre-relu sum (relu gate) —
+    two activation-sized residuals at the block's WIDEST tensor.  Here
+    the residuals are x and shortcut, and for identity blocks the
+    shortcut is the block input that the first conv's backward already
+    keeps resident, so XLA stores one activation instead of two; the
+    gate is recomputed from (x, shortcut) inside the backward's
+    existing passes."""
+    mean, var, inv = _bn_stats(x, eps)
+    mul, add = _bn_scale_bias(mean, inv, scale, bias, x.dtype)
+    return jnp.maximum(x * mul + add + shortcut, 0), mean, var
+
+
+def _bn_add_relu_train_fused_fwd(x, shortcut, scale, bias, eps):
+    mean, var, inv = _bn_stats(x, eps)
+    mul, add = _bn_scale_bias(mean, inv, scale, bias, x.dtype)
+    y = jnp.maximum(x * mul + add + shortcut, 0)
+    return (y, mean, var), (x, shortcut, mean, inv, scale, bias)
+
+
+def _bn_add_relu_train_fused_bwd(eps, res, cts):
+    x, shortcut, mean, inv, scale, bias = res
+    g, mean_ct, var_ct = cts
+    # recompute the pre-activation exactly as the forward did; sign()
+    # reproduces jnp.maximum's tie convention (gradient 1/2 at 0)
+    mul, add = _bn_scale_bias(mean, inv, scale, bias, x.dtype)
+    pre = x * mul + add + shortcut
+    gate = (jnp.sign(pre.astype(jnp.float32)) + 1.0) * 0.5
+    gm = g.astype(jnp.float32) * gate
+    dx, dscale, dbias = _bn_bwd_core(gm, x, mean, inv, scale,
+                                     mean_ct, var_ct)
+    return dx, gm.astype(shortcut.dtype), dscale, dbias
+
+
+_bn_add_relu_train_fused.defvjp(_bn_add_relu_train_fused_fwd,
+                                _bn_add_relu_train_fused_bwd)
+
+
 def _ema_state(state, mean, var, momentum):
     return {
         "mean": momentum * state["mean"] + (1 - momentum) * mean,
@@ -251,6 +293,22 @@ def batchnorm_relu(params, state, x, train=True, momentum=0.9, eps=1e-5,
     y, new_state = batchnorm(params, state, x, train=train,
                              momentum=momentum, eps=eps, fused=fused)
     return relu(y), new_state
+
+
+def batchnorm_add_relu(params, state, x, shortcut, train=True, momentum=0.9,
+                       eps=1e-5, fused=True):
+    """relu(batchnorm(x) + shortcut) — the ResNet block-end.  In fused
+    training mode the whole pattern shares one custom VJP
+    (``_bn_add_relu_train_fused``) that stores no pre-relu sum;
+    otherwise it is exactly relu(batchnorm(...) + shortcut).
+    Returns (y, new_state)."""
+    if train and fused:
+        y, mean, var = _bn_add_relu_train_fused(
+            x, shortcut, params["scale"], params["bias"], eps)
+        return y, _ema_state(state, mean, var, momentum)
+    y, new_state = batchnorm(params, state, x, train=train,
+                             momentum=momentum, eps=eps, fused=fused)
+    return relu(y + shortcut), new_state
 
 
 def layernorm_init(dim, dtype=jnp.float32):

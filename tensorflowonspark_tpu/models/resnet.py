@@ -66,9 +66,12 @@ def _block_init(key, kind, in_ch, ch, stride, dtype):
 def _block_apply(p, s, x, kind, stride, train, bn_fused=True):
     ns = {}
     bn = functools.partial(L.batchnorm, train=train, fused=bn_fused)
-    # BN→ReLU pairs route through the combined custom VJP (no stored
-    # pre-activation residual) when bn_fused; see layers.batchnorm_relu
+    # BN→ReLU pairs (and the block-end BN→add→ReLU) route through
+    # combined custom VJPs — no stored pre-activation residuals — when
+    # bn_fused; see layers.batchnorm_relu / batchnorm_add_relu
     bnr = functools.partial(L.batchnorm_relu, train=train, fused=bn_fused)
+    bnar = functools.partial(L.batchnorm_add_relu, train=train,
+                             fused=bn_fused)
     shortcut = x
     if "proj" in p:
         shortcut = L.conv(p["proj"], x, stride=stride)
@@ -79,13 +82,13 @@ def _block_apply(p, s, x, kind, stride, train, bn_fused=True):
         y = L.conv(p["conv2"], y, stride=stride)
         y, ns["bn2"] = bnr(p["bn2"], s["bn2"], y)
         y = L.conv(p["conv3"], y)
-        y, ns["bn3"] = bn(p["bn3"], s["bn3"], y)
+        y, ns["bn3"] = bnar(p["bn3"], s["bn3"], y, shortcut)
     else:
         y = L.conv(p["conv1"], x, stride=stride)
         y, ns["bn1"] = bnr(p["bn1"], s["bn1"], y)
         y = L.conv(p["conv2"], y)
-        y, ns["bn2"] = bn(p["bn2"], s["bn2"], y)
-    return L.relu(y + shortcut), ns
+        y, ns["bn2"] = bnar(p["bn2"], s["bn2"], y, shortcut)
+    return y, ns
 
 
 def init(key, depth=50, num_classes=1000, width=64, small_inputs=False,

@@ -214,6 +214,54 @@ def test_batchnorm_relu_fused_vjp_parity():
     np.testing.assert_allclose(ye, yep, rtol=0, atol=0)
 
 
+def test_batchnorm_add_relu_fused_vjp_parity():
+    """relu(bn(x) + shortcut) fused VJP vs the plain path: value,
+    running stats, and gradients for x, shortcut, scale, bias —
+    including the tie case via zeroed channels."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import layers as L
+
+    key = jax.random.PRNGKey(3)
+    k1, k2 = jax.random.split(key)
+    x = jax.random.normal(k1, (4, 5, 5, 8), jnp.float32) * 1.5
+    shortcut = jax.random.normal(k2, (4, 5, 5, 8), jnp.float32)
+    # a zeroed channel in BOTH scale/bias and shortcut → pre exactly 0
+    shortcut = shortcut.at[..., 2].set(0.0)
+    params = {"scale": jnp.linspace(0.5, 1.5, 8).at[2].set(0.0),
+              "bias": jnp.linspace(-0.5, 0.5, 8).at[2].set(0.0)}
+    state = {"mean": jnp.zeros(8), "var": jnp.ones(8)}
+
+    def loss(p, x, sc, fused):
+        y, new = L.batchnorm_add_relu(p, state, x, sc, train=True,
+                                      fused=fused)
+        return (jnp.sum(jnp.tanh(y)) + jnp.sum(new["mean"])
+                + jnp.sum(new["var"]))
+
+    y_f, new_f = L.batchnorm_add_relu(params, state, x, shortcut,
+                                      train=True, fused=True)
+    y_p, new_p = L.batchnorm_add_relu(params, state, x, shortcut,
+                                      train=True, fused=False)
+    np.testing.assert_allclose(y_f, y_p, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(new_f["mean"], new_p["mean"], rtol=1e-6)
+    np.testing.assert_allclose(new_f["var"], new_p["var"], rtol=1e-6)
+    assert float(jnp.min(y_f)) >= 0.0
+
+    gf = jax.grad(loss, argnums=(0, 1, 2))(params, x, shortcut, True)
+    gp = jax.grad(loss, argnums=(0, 1, 2))(params, x, shortcut, False)
+    for a, b in ((gf[0]["scale"], gp[0]["scale"]),
+                 (gf[0]["bias"], gp[0]["bias"]),
+                 (gf[1], gp[1]), (gf[2], gp[2])):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+    # eval mode: identical plain path either way
+    ye, _ = L.batchnorm_add_relu(params, state, x, shortcut, train=False,
+                                 fused=True)
+    yep, _ = L.batchnorm_add_relu(params, state, x, shortcut, train=False,
+                                  fused=False)
+    np.testing.assert_allclose(ye, yep, rtol=0, atol=0)
+
+
 def test_batchnorm_fused_bf16_train_step_parity():
     """Full ResNet train step: fused-BN gradients track the autodiff path
     in bf16 within bf16 noise, and the step still learns."""
