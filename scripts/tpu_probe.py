@@ -5,15 +5,38 @@ tunnel/pool is sick — better to learn that up front than 25 minutes
 into the first ResNet compile (the round-3 failure mode).
 
 Exit codes: 0 healthy; 2 backend is CPU (no TPU behind the tunnel);
-3 device returned a wrong result.
+3 device returned a wrong result; 4 relay port closed (diagnosed
+pre-jax: with the axon site hook present, `import jax` HANGS on a dead
+tunnel, so without this check a dead relay costs the caller's full
+probe timeout instead of ~2 s).
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
 
+def _relay_port_dead():
+    """True when we are headed for the axon backend but its loopback
+    relay refuses connections (terminal: nothing in the VM restarts it).
+    Skipped when JAX_PLATFORMS pins a non-axon backend (CPU smoke)."""
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if platforms and "axon" not in platforms:
+        return False
+    port = os.environ.get("TFOS_RELAY_PORT", "8082")
+    rc = subprocess.call(
+        ["timeout", "2", "bash", "-c", f"echo > /dev/tcp/127.0.0.1/{port}"],
+        stderr=subprocess.DEVNULL)
+    return rc != 0
+
+
 def main():
+    if _relay_port_dead():
+        print("probe: axon relay port refused - tunnel is dead "
+              "(import jax would hang)", file=sys.stderr, flush=True)
+        raise SystemExit(4)
     t0 = time.perf_counter()
     import jax
     import jax.numpy as jnp

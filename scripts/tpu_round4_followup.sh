@@ -2,11 +2,12 @@
 # Round-4 follow-up chip session (v2, after the second relay death):
 # everything still unmeasured, cheapest-and-most-informative first.
 # Probe-gated like tpu_perf_session.sh; each step its own process
-# (serialized claims) wrapped in `timeout` (a compile request against a
-# dying helper once wedged 47 min).
+# (serialized claims) under scripts/with_tunnel_watchdog.sh, which
+# kills the step within ~1 min of the relay dying (rc 86, session
+# aborts) instead of burning the step's full timeout budget.
 #
 #   1. Roofline (chained-timing rewrite) -> ROOFLINE.json
-#   2. ResNet sweep over fused-BN(+ReLU) configs, promote
+#   2. ResNet sweep over fused-BN(+ReLU/+add+ReLU) configs, promote
 #      (b256_s2d_bnf measured 99.2ms pre-bn_relu: direct A/B)
 #   3. Analytic traffic floor vs measured roofline -> TRAFFIC.json
 #   4. Re-profile the winner -> PERF_BREAKDOWN.md
@@ -21,10 +22,23 @@ echo "== r4 follow-up session v2 $(date -u +%FT%TZ) ==" | tee -a "$log"
 export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/tfos_xla_cache}
 mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 
-run() {
-  echo "-- $* --" | tee -a "$log"
-  "$@" 2>&1 | tee -a "$log"
-  echo "-- rc=$? --" | tee -a "$log"
+run() {  # run <timeout_s> cmd... ; aborts the session if the relay died
+  local tmo=$1; shift
+  echo "-- $* (watchdog ${tmo}s) --" | tee -a "$log"
+  bash scripts/with_tunnel_watchdog.sh "$tmo" "$@" 2>&1 | tee -a "$log"
+  local rc=${PIPESTATUS[0]}
+  echo "-- rc=$rc --" | tee -a "$log"
+  if [ "$rc" = "86" ]; then
+    echo "ABORT: relay died mid-step; nothing in the VM can restart it" \
+      | tee -a "$log"
+    exit 86
+  fi
+  if [ "$rc" = "127" ] || [ "$rc" = "126" ]; then
+    echo "ABORT: step harness missing/not executable (rc=$rc) - a" \
+         "broken checkout must not silently burn the chip window" \
+      | tee -a "$log"
+    exit "$rc"
+  fi
 }
 
 echo "-- tpu_probe --" | tee -a "$log"
@@ -36,15 +50,15 @@ if [ "$probe_rc" != "0" ]; then
   exit "$probe_rc"
 fi
 
-run timeout 1800 python scripts/roofline.py --out ROOFLINE.json
+run 1800 python scripts/roofline.py --out ROOFLINE.json
 TFOS_SWEEP=b256_s2d_bnf,b384_s2d_bnf,b256_s2d \
-  run timeout 7200 python scripts/sweep_resnet.py --steps 20 --image 224 --promote
-run timeout 600 python scripts/resnet_traffic.py --batch 256 --out TRAFFIC.json
-run timeout 3600 python scripts/profile_resnet.py --out PERF_BREAKDOWN.md \
+  run 7200 python scripts/sweep_resnet.py --steps 20 --image 224 --promote
+run 600 python scripts/resnet_traffic.py --batch 256 --out TRAFFIC.json
+run 3600 python scripts/profile_resnet.py --out PERF_BREAKDOWN.md \
     --steps 10 --image 224 $(python scripts/promoted_profile_args.py)
 TFOS_SWEEP=b64_q512_kv512_rdots_pbwd,b96_q512_kv512_rdots_pbwd,b96_q512_kv512_remat_pbwd \
-  run timeout 7200 python scripts/sweep_transformer.py --steps 8 --promote
-run timeout 7200 python bench.py
+  run 7200 python scripts/sweep_transformer.py --steps 8 --promote
+run 7200 python bench.py
 
 echo "== done; promoted config: ==" | tee -a "$log"
 cat "${TFOS_BENCH_CONFIG:-bench_config.json}" 2>/dev/null | tee -a "$log" || true

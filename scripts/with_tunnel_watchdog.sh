@@ -1,0 +1,49 @@
+#!/bin/bash
+# Run one chip-session step under a tunnel watchdog.
+#
+# The axon relay has died mid-step twice this round; a step blocked on
+# a dead relay otherwise burns its full `timeout` budget (up to 2 h)
+# doing nothing — and nothing inside the VM can restart the relay (its
+# stdio is wired to the host), so a closed port is terminal.  This
+# wrapper kills the step's whole process group (sweeps/bench spawn
+# feeder children) within ~1 min of the relay port closing.
+#
+# Usage: with_tunnel_watchdog.sh <timeout_s> cmd...
+# Exit: the command's rc; 124 on timeout; 86 when the relay died
+#       (callers should abort the whole session on 86).
+set -u
+tmo=$1; shift
+port=${TFOS_RELAY_PORT:-8082}
+
+setsid "$@" &
+pid=$!
+# the step runs detached in its own session and never sees the
+# terminal's SIGINT — forward INT/TERM to the whole group so an
+# interrupted session can't orphan a jax-on-axon process holding the
+# serialized TPU claim
+trap 'kill -9 -- "-$pid" 2>/dev/null; exit 130' INT TERM
+deadline=$(( $(date +%s) + tmo ))
+closed=0
+while kill -0 "$pid" 2>/dev/null; do
+  if [ "$(date +%s)" -ge "$deadline" ]; then
+    echo "WATCHDOG: step exceeded ${tmo}s; killing process group" >&2
+    kill -9 -- "-$pid" 2>/dev/null
+    wait "$pid" 2>/dev/null
+    exit 124
+  fi
+  if timeout 2 bash -c "echo > /dev/tcp/127.0.0.1/$port" 2>/dev/null; then
+    closed=0
+  else
+    closed=$((closed + 1))
+    if [ "$closed" -ge 4 ]; then
+      echo "WATCHDOG: relay port $port closed (4 consecutive probes) -" \
+           "tunnel is dead; killing process group" >&2
+      kill -9 -- "-$pid" 2>/dev/null
+      wait "$pid" 2>/dev/null
+      exit 86
+    fi
+  fi
+  sleep 15
+done
+wait "$pid"
+exit $?
