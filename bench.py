@@ -159,11 +159,11 @@ def _fed_run(fed, step_fn, params, state, opt_state, loop_ips=None,
         res_labels = jax.device_put(
             rng.integers(0, 1000, batch).astype(np.int32))
         p, s, o, loss, _ = fed_step(p, s, o, res_imgs, res_labels)  # compile
-        loss.block_until_ready()
-        t0 = time.perf_counter()
+        float(loss)  # value fetch: block_until_ready is not a reliable
+        t0 = time.perf_counter()  # barrier through the relay (PERF.md r4)
         for _ in range(steps):
             p, s, o, loss, _ = fed_step(p, s, o, res_imgs, res_labels)
-        loss.block_until_ready()
+        float(loss)
         loop_ips = batch * steps / (time.perf_counter() - t0)
 
     if xfer_ips is None:
@@ -180,10 +180,13 @@ def _fed_run(fed, step_fn, params, state, opt_state, loop_ips=None,
                                         str(min(steps, 2))))
         bufs = [rng.integers(0, 256, (batch, image, image, 3),
                              dtype=np.uint8) for _ in range(2)]
-        jax.device_put(bufs[0]).block_until_ready()  # warm the path
+        # a 1-element readback after each put is the completion proof
+        # (block_until_ready is not a reliable barrier through the
+        # relay - PERF.md r4); its cost is one tiny round trip
+        int(jax.device_put(bufs[0])[0, 0, 0, 0])  # warm the path
         t0 = time.perf_counter()
         for i in range(xfer_steps):
-            jax.device_put(bufs[i % 2]).block_until_ready()
+            int(jax.device_put(bufs[i % 2])[0, 0, 0, 0])
         xfer_ips = batch * xfer_steps / (time.perf_counter() - t0)
 
     metrics = TrainMetrics()
@@ -262,7 +265,8 @@ def _fed_run(fed, step_fn, params, state, opt_state, loop_ips=None,
         nsteps += 1
         progress["n"] = nsteps
         if nsteps == 1:
-            last.block_until_ready()  # absorb any warmup/compile skew
+            float(last)  # absorb warmup/compile skew (value fetch: a
+            # reliable completion barrier through the relay, PERF.md r4)
             t0 = time.perf_counter()
             wait_base = metrics.infeed_time  # align stall window with dt
         else:
@@ -273,7 +277,7 @@ def _fed_run(fed, step_fn, params, state, opt_state, loop_ips=None,
         fed["mgr"].set("state", "stopped")
         fed["ring"].close()
         return {"error": f"no fed batches completed (feeder exitcode={rc})"}
-    last.block_until_ready()
+    float(last)
     dt = time.perf_counter() - t0
     fed_ips = batch * n_timed / dt
     stall = max(metrics.infeed_time - wait_base, 0.0)
@@ -740,13 +744,13 @@ def _tfrecord_bench(dev, on_tpu):
         it = batches()
         x, y = next(it)
         params, opt_state, loss, _ = step(params, opt_state, x, y)
-        jax.block_until_ready(loss)
+        float(loss)  # value-fetch barriers (PERF.md r4)
         t0 = time.perf_counter()
         n_img = 0
         for x, y in it:
             params, opt_state, loss, _ = step(params, opt_state, x, y)
             n_img += len(y)
-        jax.block_until_ready(loss)
+        float(loss)
         dt = time.perf_counter() - t0
         return {
             "decode_records_per_sec": round(n_rec / read_dt, 1),

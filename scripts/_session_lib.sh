@@ -1,0 +1,55 @@
+# Shared helpers for the on-chip perf session scripts (sourced by
+# tpu_perf_session.sh and tpu_round4_followup.sh; not executable).
+# Requires: $log set by the caller; set -uo pipefail recommended.
+
+# session_run <timeout_s> cmd... — one chip step under the tunnel
+# watchdog (scripts/with_tunnel_watchdog.sh): killed within ~1 min of
+# the relay dying (rc 86 -> session aborts; a dead relay is terminal),
+# bounded by <timeout_s> (rc 124 logs and continues: partial results
+# beat none), aborts on 126/127 (broken checkout must not silently
+# burn the chip window).  TFOS_SESSION_SMOKE=1 disables the watchdog's
+# port check (CPU dry runs have no relay to watch).
+session_run() {
+  local tmo=$1; shift
+  echo "-- $* (watchdog ${tmo}s) --" | tee -a "$log"
+  TFOS_WATCHDOG_DISABLE="${TFOS_SESSION_SMOKE:-0}" \
+    bash scripts/with_tunnel_watchdog.sh "$tmo" "$@" 2>&1 | tee -a "$log"
+  local rc=${PIPESTATUS[0]}
+  echo "-- rc=$rc --" | tee -a "$log"
+  if [ "$rc" = "86" ]; then
+    echo "ABORT: relay died mid-step; nothing in the VM can restart it" \
+      | tee -a "$log"
+    exit 86
+  fi
+  if [ "$rc" = "127" ] || [ "$rc" = "126" ]; then
+    echo "ABORT: step harness missing/not executable (rc=$rc)" \
+      | tee -a "$log"
+    exit "$rc"
+  fi
+}
+
+# host_run <timeout_s> cmd... — a step that claims no TPU (e.g.
+# stress_fed): plain timeout, no tunnel watchdog, never aborts.
+host_run() {
+  local tmo=$1; shift
+  echo "-- $* (host, timeout ${tmo}s) --" | tee -a "$log"
+  timeout "$tmo" "$@" 2>&1 | tee -a "$log"
+  echo "-- rc=${PIPESTATUS[0]} --" | tee -a "$log"
+}
+
+# probe_gate — bounded liveness probe BEFORE any big compile; ABORTS
+# the session when the tunnel/pool is sick (rc 4 = relay port closed,
+# diagnosed pre-jax in ~2 s; 124 = probe hang; 2 = cpu backend;
+# 3 = wrong result).
+probe_gate() {
+  echo "-- tpu_probe --" | tee -a "$log"
+  timeout "${TFOS_SESSION_PROBE_TIMEOUT:-300}" python scripts/tpu_probe.py 2>&1 | tee -a "$log"
+  local probe_rc=${PIPESTATUS[0]}
+  echo "-- rc=$probe_rc --" | tee -a "$log"
+  if [ "$probe_rc" != "0" ]; then
+    echo "ABORT: TPU probe failed (rc=$probe_rc; 4=relay dead, \
+124=timeout/hang, 2=cpu backend, 3=wrong result) - tunnel/pool is sick, \
+not claiming further" | tee -a "$log"
+    exit "$probe_rc"
+  fi
+}

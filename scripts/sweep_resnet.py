@@ -57,6 +57,61 @@ def measure(step_fn, params, state, opt_state, images, labels, steps):
         (_, _, _), losses = lax.scan(
             body, (params, state, opt_state), None, length=steps)
         return losses[-1]
+    return _timed(run, params, state, opt_state, images, labels, steps)
+
+
+def measure_decomposed(mode, opt, cfg_kwargs, params, state, opt_state,
+                       images, labels, steps):
+    """TFOS_SWEEP_MODE=fwd|grad step-time decomposition (no promote):
+    'fwd' scans the forward loss only; 'grad' scans value_and_grad but
+    skips the optimizer update.  train - grad = optimizer cost;
+    grad - fwd = backward cost.  One chip claim, no profiler."""
+    import jax
+    from jax import lax
+
+    from tensorflowonspark_tpu.models import resnet
+
+    def loss_fn(p, s, x, y):
+        logits, new_s = resnet.apply(
+            p, s, x, depth=50, train=True,
+            compute_dtype=jax.numpy.bfloat16,
+            stem_s2d=cfg_kwargs["stem_s2d"], bn_fused=cfg_kwargs["bn_fused"])
+        from tensorflowonspark_tpu.models import layers as L
+        return L.softmax_cross_entropy(logits, y), new_s
+
+    if mode == "fwd":
+        @jax.jit
+        def run(params, state, opt_state, images, labels):
+            # the loss must depend on the carry or XLA's while-loop
+            # invariant code motion hoists the whole forward out of the
+            # scan (train-mode BN reads only params/images).  eps is a
+            # zero-valued scalar chained through the previous loss —
+            # value-neutral, but it serializes the iterations.
+            def body(carry, _):
+                s, eps = carry
+                loss, new_s = loss_fn(params, s, images + eps, labels)
+                return (new_s, (0.0 * loss).astype(images.dtype)), loss
+            zero = jax.numpy.zeros((), images.dtype)
+            _, losses = lax.scan(body, (state, zero), None, length=steps)
+            return losses[-1]
+    else:  # grad
+        @jax.jit
+        def run(params, state, opt_state, images, labels):
+            def body(carry, _):
+                p, s = carry
+                (loss, new_s), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(p, s, images, labels)
+                # consume grads without an optimizer: fold a zero-scaled
+                # update into the carry so XLA cannot DCE the backward
+                p = jax.tree.map(lambda a, g: a - 0.0 * g, p, grads)
+                return (p, new_s), loss
+            _, losses = lax.scan(body, (params, state), None, length=steps)
+            return losses[-1]
+    return _timed(run, params, state, opt_state, images, labels, steps)
+
+
+def _timed(run, params, state, opt_state, images, labels, steps):
+    import time
 
     t0 = time.perf_counter()
     float(run(params, state, opt_state, images, labels))  # compile+warmup
@@ -111,6 +166,12 @@ def main():
             or os.environ.get("TFOS_SWEEP_TINY") == "1":
         configs = [(n, 4, s, r, bf) for n, _, s, r, bf in configs[:2]]
 
+    # TFOS_SWEEP_MODE=fwd|grad decomposes the step (no remat support,
+    # no promote: fwd/grad "mfu" is not comparable to the train metric)
+    mode = os.environ.get("TFOS_SWEEP_MODE", "train")
+    if mode not in ("train", "fwd", "grad"):
+        raise SystemExit(f"bad TFOS_SWEEP_MODE {mode!r}")
+
     rng = np.random.default_rng(0)
     results = []
     by_name = {}
@@ -122,13 +183,26 @@ def main():
                 rng.random((batch, args.image, args.image, 3),
                            dtype=np.float32), jnp.bfloat16)
             labels = jnp.asarray(rng.integers(0, 1000, batch), jnp.int32)
-            step_fn = resnet.make_train_step(
-                opt, depth=50, stem_s2d=s2d, remat=remat, bn_fused=bnf)
-            sec, compile_s = measure(
-                step_fn, params, state, opt_state, images, labels, args.steps)
+            if mode == "train":
+                step_fn = resnet.make_train_step(
+                    opt, depth=50, stem_s2d=s2d, remat=remat, bn_fused=bnf)
+                sec, compile_s = measure(
+                    step_fn, params, state, opt_state, images, labels,
+                    args.steps)
+            elif remat:
+                # decomposed builds ignore remat - timing a non-remat
+                # program under a *_remat name would mislabel it (and
+                # risk the HBM-pressure compile crash remat avoids)
+                print(f"{name:18s} SKIPPED ({mode} mode has no remat)",
+                      flush=True)
+                continue
+            else:
+                sec, compile_s = measure_decomposed(
+                    mode, opt, {"stem_s2d": s2d, "bn_fused": bnf},
+                    params, state, opt_state, images, labels, args.steps)
             ips = batch / sec
             mfu = ips * flops_img / peak
-            print(f"{name:18s} step={sec*1e3:7.1f}ms  img/s={ips:7.0f}  "
+            print(f"{name:18s} {mode}={sec*1e3:7.1f}ms  img/s={ips:7.0f}  "
                   f"mfu={mfu:.4f}  (compile {compile_s:.0f}s)", flush=True)
             results.append((mfu, name))
             by_name[name] = {"batch": batch, "stem_s2d": s2d, "remat": remat,
@@ -140,6 +214,10 @@ def main():
     if args.promote and results:
         import json
 
+        if mode != "train":
+            print(f"promote skipped: TFOS_SWEEP_MODE={mode} times a "
+                  f"partial step - not the bench metric", flush=True)
+            return
         tiny = os.environ.get("TFOS_SWEEP_TINY") == "1" and \
             os.environ.get("TFOS_SWEEP_TINY_PROMOTE_OK") != "1"
         if os.environ.get("TFOS_SWEEP_SMOKE") == "1" or tiny or \

@@ -1,15 +1,18 @@
 #!/bin/bash
 # One-command on-chip perf session (PERF.md's plan, in order):
 #
-#   1. ResNet-50 sweep (stem x batch x remat), promote the winner
+#   1. ResNet-50 sweep (stem x batch x remat x bn-fusion), promote
 #   2. Profile the winning config -> PERF_BREAKDOWN.md (where time goes)
-#   3. Transformer sweep (batch x flash blocks x remat x bwd), promote
+#   3. Transformer sweep (batch x flash blocks x remat x bwd x CE), promote
 #   4. Run bench.py with the promoted configs -> the round's JSON line
 #
-# Each step is its own process (the tunnel serializes TPU claims); a
-# step failing does not stop the later ones — partial results beat none.
-# Check tunnel liveness first: scripts print nothing for many minutes
-# during big compiles, which is normal (see CLAUDE.md).
+# Each step is its own process (the tunnel serializes TPU claims) under
+# scripts/with_tunnel_watchdog.sh via _session_lib.sh: a step is killed
+# within ~1 min of the relay dying (session aborts - a dead relay is
+# terminal) and bounded by a per-step timeout (a timed-out step logs
+# and the session continues: partial results beat none).  Scripts print
+# nothing for many minutes during big compiles, which is normal
+# (see CLAUDE.md).
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
@@ -19,6 +22,7 @@ fi
 
 log=${TFOS_PERF_LOG:-perf_session.log}
 echo "== tpu perf session $(date -u +%FT%TZ) ==" | tee -a "$log"
+source scripts/_session_lib.sh
 
 # persistent XLA compilation cache shared across the session's processes:
 # the winning config is compiled by the sweep, then AGAIN by profile,
@@ -28,55 +32,41 @@ export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/tfos_xla_cach
 mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 
 # TFOS_SESSION_SMOKE=1: CPU dry run of the WHOLE session pipeline (tiny
-# shapes, promote refused by the sweeps, bench skipped) so script bugs
-# surface here, not in the first minutes of a live chip claim.
+# shapes, promote refused by the sweeps, bench skipped, watchdog port
+# check off) so script bugs surface here, not in the first minutes of a
+# live chip claim.
 profile_extra=""
 if [ "${TFOS_SESSION_SMOKE:-0}" = "1" ]; then
   export TFOS_SWEEP_SMOKE=1
   profile_extra="--batch 4"
   echo "(smoke mode: tiny shapes, no promote, bench skipped)" | tee -a "$log"
+else
+  probe_gate
 fi
 
-run() {
-  echo "-- $* --" | tee -a "$log"
-  "$@" 2>&1 | tee -a "$log"
-  echo "-- rc=$? --" | tee -a "$log"
-}
-
-# Bounded liveness probe (default 5 min) BEFORE any big compile: round 3
-# lost the whole session to a tunnel that died mid-ResNet-compile with no
-# signal.  A failed/hung probe ABORTS — every later step's `import jax`
-# would hang unbounded against the same dead tunnel.
-if [ "${TFOS_SESSION_SMOKE:-0}" != "1" ]; then
-  echo "-- tpu_probe --" | tee -a "$log"
-  timeout "${TFOS_SESSION_PROBE_TIMEOUT:-300}" python scripts/tpu_probe.py 2>&1 | tee -a "$log"
-  probe_rc=${PIPESTATUS[0]}
-  echo "-- rc=$probe_rc --" | tee -a "$log"
-  if [ "$probe_rc" != "0" ]; then
-    echo "ABORT: TPU probe failed (rc=$probe_rc; 124=timeout/hang, 2=cpu \
-backend, 3=wrong result) - tunnel/pool is sick, not claiming further" | tee -a "$log"
-    exit "$probe_rc"
-  fi
-fi
-
-run python scripts/sweep_resnet.py --steps "${TFOS_SESSION_RESNET_STEPS:-20}" --image "${TFOS_SESSION_IMAGE:-224}" --promote
+session_run 7200 python scripts/sweep_resnet.py \
+    --steps "${TFOS_SESSION_RESNET_STEPS:-20}" \
+    --image "${TFOS_SESSION_IMAGE:-224}" --promote
 # promoted-config args come first so $profile_extra (smoke mode's
 # --batch 4) wins argparse's last-takes-effect — a CPU dry run must
 # never profile at a previously promoted TPU batch size
-run python scripts/profile_resnet.py --out "${TFOS_SESSION_BREAKDOWN:-PERF_BREAKDOWN.md}" \
-    --steps "${TFOS_SESSION_RESNET_STEPS:-10}" --image "${TFOS_SESSION_IMAGE:-224}" \
+session_run 3600 python scripts/profile_resnet.py \
+    --out "${TFOS_SESSION_BREAKDOWN:-PERF_BREAKDOWN.md}" \
+    --steps "${TFOS_SESSION_RESNET_STEPS:-10}" \
+    --image "${TFOS_SESSION_IMAGE:-224}" \
     $(python scripts/promoted_profile_args.py) \
     $profile_extra
-run python scripts/sweep_transformer.py --steps "${TFOS_SESSION_TRANSFORMER_STEPS:-8}" --promote
+session_run 7200 python scripts/sweep_transformer.py \
+    --steps "${TFOS_SESSION_TRANSFORMER_STEPS:-8}" --promote
 # host-side fed-consumer ceiling (no TPU claim: feeder+DataFeed only) —
 # the number that bounds fed training throughput on THIS host
 if [ "${TFOS_SESSION_STRESS:-1}" = "1" ] && [ "${TFOS_SESSION_SMOKE:-0}" != "1" ]; then
-  run python scripts/stress_fed.py --batch 256 --image 224 --steps 24
+  host_run 1800 python scripts/stress_fed.py --batch 256 --image 224 --steps 24
 fi
 if [ "${TFOS_SESSION_SMOKE:-0}" = "1" ]; then
   echo "-- bench.py skipped (smoke mode) --" | tee -a "$log"
 else
-  run python bench.py
+  session_run 7200 python bench.py
 fi
 
 echo "== done; promoted config: ==" | tee -a "$log"

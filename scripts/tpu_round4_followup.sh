@@ -1,64 +1,45 @@
 #!/bin/bash
 # Round-4 follow-up chip session (v2, after the second relay death):
 # everything still unmeasured, cheapest-and-most-informative first.
-# Probe-gated like tpu_perf_session.sh; each step its own process
-# (serialized claims) under scripts/with_tunnel_watchdog.sh, which
-# kills the step within ~1 min of the relay dying (rc 86, session
-# aborts) instead of burning the step's full timeout budget.
+# Probe-gated; each step its own process (serialized claims) under the
+# tunnel watchdog via _session_lib.sh (see tpu_perf_session.sh for the
+# failure semantics).
 #
 #   1. Roofline (chained-timing rewrite) -> ROOFLINE.json
 #   2. ResNet sweep over fused-BN(+ReLU/+add+ReLU) configs, promote
 #      (b256_s2d_bnf measured 99.2ms pre-bn_relu: direct A/B)
 #   3. Analytic traffic floor vs measured roofline -> TRAFFIC.json
-#   4. Re-profile the winner -> PERF_BREAKDOWN.md
-#   5. Transformer selective-remat subset (rdots/b96), promote
-#   6. bench.py -> the round's JSON line with promoted configs
+#   4. fwd/grad step decomposition of the winner (no profiler needed)
+#   5. Re-profile the winner -> PERF_BREAKDOWN.md
+#   6. Transformer selective-remat subset (rdots/b96), promote
+#   7. bench.py -> the round's JSON line with promoted configs
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
 log=${TFOS_PERF_LOG:-perf_followup_r4.log}
 echo "== r4 follow-up session v2 $(date -u +%FT%TZ) ==" | tee -a "$log"
+source scripts/_session_lib.sh
 
 export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/tfos_xla_cache}
 mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 
-run() {  # run <timeout_s> cmd... ; aborts the session if the relay died
-  local tmo=$1; shift
-  echo "-- $* (watchdog ${tmo}s) --" | tee -a "$log"
-  bash scripts/with_tunnel_watchdog.sh "$tmo" "$@" 2>&1 | tee -a "$log"
-  local rc=${PIPESTATUS[0]}
-  echo "-- rc=$rc --" | tee -a "$log"
-  if [ "$rc" = "86" ]; then
-    echo "ABORT: relay died mid-step; nothing in the VM can restart it" \
-      | tee -a "$log"
-    exit 86
-  fi
-  if [ "$rc" = "127" ] || [ "$rc" = "126" ]; then
-    echo "ABORT: step harness missing/not executable (rc=$rc) - a" \
-         "broken checkout must not silently burn the chip window" \
-      | tee -a "$log"
-    exit "$rc"
-  fi
-}
+probe_gate
 
-echo "-- tpu_probe --" | tee -a "$log"
-timeout "${TFOS_SESSION_PROBE_TIMEOUT:-300}" python scripts/tpu_probe.py 2>&1 | tee -a "$log"
-probe_rc=${PIPESTATUS[0]}
-echo "-- rc=$probe_rc --" | tee -a "$log"
-if [ "$probe_rc" != "0" ]; then
-  echo "ABORT: TPU probe failed (rc=$probe_rc) - tunnel/pool sick" | tee -a "$log"
-  exit "$probe_rc"
-fi
-
-run 1800 python scripts/roofline.py --out ROOFLINE.json
+session_run 1800 python scripts/roofline.py --out ROOFLINE.json
 TFOS_SWEEP=b256_s2d_bnf,b384_s2d_bnf,b256_s2d \
-  run 7200 python scripts/sweep_resnet.py --steps 20 --image 224 --promote
-run 600 python scripts/resnet_traffic.py --batch 256 --out TRAFFIC.json
-run 3600 python scripts/profile_resnet.py --out PERF_BREAKDOWN.md \
+  session_run 7200 python scripts/sweep_resnet.py --steps 20 --image 224 --promote
+host_run 600 python scripts/resnet_traffic.py --batch 256 --out TRAFFIC.json
+# step decomposition of the winner config: train - grad = optimizer,
+# grad - fwd = backward (one claim each, no profiler)
+TFOS_SWEEP=b256_s2d_bnf TFOS_SWEEP_MODE=fwd \
+  session_run 3600 python scripts/sweep_resnet.py --steps 20 --image 224
+TFOS_SWEEP=b256_s2d_bnf TFOS_SWEEP_MODE=grad \
+  session_run 3600 python scripts/sweep_resnet.py --steps 20 --image 224
+session_run 3600 python scripts/profile_resnet.py --out PERF_BREAKDOWN.md \
     --steps 10 --image 224 $(python scripts/promoted_profile_args.py)
 TFOS_SWEEP=b64_q512_kv512_rdots_pbwd,b96_q512_kv512_rdots_pbwd,b96_q512_kv512_remat_pbwd \
-  run 7200 python scripts/sweep_transformer.py --steps 8 --promote
-run 7200 python bench.py
+  session_run 7200 python scripts/sweep_transformer.py --steps 8 --promote
+session_run 7200 python bench.py
 
 echo "== done; promoted config: ==" | tee -a "$log"
 cat "${TFOS_BENCH_CONFIG:-bench_config.json}" 2>/dev/null | tee -a "$log" || true

@@ -15,6 +15,12 @@ set -u
 tmo=$1; shift
 port=${TFOS_RELAY_PORT:-8082}
 
+# TFOS_WATCHDOG_DISABLE=1: no relay to watch (CPU smoke/dry runs) —
+# degrade to a plain bounded run
+if [ "${TFOS_WATCHDOG_DISABLE:-0}" = "1" ]; then
+  exec timeout "$tmo" "$@"
+fi
+
 setsid "$@" &
 pid=$!
 # the step runs detached in its own session and never sees the
