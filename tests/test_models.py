@@ -262,6 +262,31 @@ def test_batchnorm_add_relu_fused_vjp_parity():
     np.testing.assert_allclose(ye, yep, rtol=0, atol=0)
 
 
+def test_fused_bn_family_under_remat():
+    """The three fused-BN custom VJPs must compose with jax.checkpoint
+    (the sweep's *_remat_bnf configs): same loss with and without remat."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tensorflowonspark_tpu.models import resnet
+
+    params, state = resnet.init(jax.random.PRNGKey(0), depth=20,
+                                num_classes=10, width=16, small_inputs=True)
+    opt = optax.sgd(0.1)
+    opt_state = opt.init(params)
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.random((8, 32, 32, 3), np.float32))
+    labels = jnp.asarray(rng.integers(0, 10, 8), jnp.int32)
+    losses = {}
+    for remat in (False, True):
+        step = jax.jit(resnet.make_train_step(
+            opt, depth=20, small_inputs=True, remat=remat, bn_fused=True))
+        _, _, _, loss, _ = step(params, state, opt_state, images, labels)
+        losses[remat] = float(loss)
+    assert abs(losses[True] - losses[False]) < 1e-2, losses
+
+
 def test_batchnorm_fused_bf16_train_step_parity():
     """Full ResNet train step: fused-BN gradients track the autodiff path
     in bf16 within bf16 noise, and the step still learns."""
