@@ -48,8 +48,16 @@ def decode_record(feats, image_size):
     if isinstance(label, list):
         label = label[0]
 
-    compressed = data[:2] == _JPEG_MAGIC or data[:4] == _PNG_MAGIC
-    if compressed:
+    if data[:2] == _JPEG_MAGIC:
+        # native libjpeg path when built (DCT-scaled decode + C resize,
+        # GIL-free — recordio/jpeg.py).  The native decoder is strict;
+        # anything it refuses (CMYK, warning-emitting streams) retries
+        # through PIL inside decode_resized, so valid-but-odd images
+        # still decode and corrupt ones still raise ValueError.
+        from tensorflowonspark_tpu.recordio import jpeg as _jpeg
+
+        return _jpeg.decode_resized(data, image_size), int(label)
+    if data[:4] == _PNG_MAGIC:
         from PIL import Image  # host-side decode, one per record
 
         img = Image.open(io.BytesIO(data)).convert("RGB")

@@ -1,0 +1,126 @@
+"""Native JPEG decode (recordio/jpeg.py over native/jpegdec.c):
+parity with the PIL path, edge shapes, error handling, batch, fallback.
+
+Parity workload: the host-side image decode the reference does with
+tf.image.decode_jpeg in examples/resnet/imagenet_preprocessing.py.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.recordio import jpeg as J
+
+
+def _smooth(h, w):
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    return np.stack([
+        128 + 100 * np.sin(xx / 40) * np.cos(yy / 60),
+        128 + 80 * np.sin((xx + yy) / 50),
+        128 + 60 * np.cos(xx / 30),
+    ], -1).clip(0, 255).astype(np.uint8)
+
+
+def _encode(arr, mode=None, quality=90):
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(arr, mode=mode).save(buf, "JPEG", quality=quality)
+    return buf.getvalue()
+
+
+def _pil_decode_resized(data, size):
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(data)).convert("RGB")
+    return np.asarray(img.resize((size, size), Image.BILINEAR), np.uint8)
+
+
+def test_parity_with_pil_on_smooth_image():
+    data = _encode(_smooth(500, 700))
+    nat = J.decode_resized(data, 224)
+    pil = _pil_decode_resized(data, 224)
+    assert nat.shape == (224, 224, 3) and nat.dtype == np.uint8
+    # different IDCT/resample implementations: close, not identical
+    assert float(np.abs(nat.astype(int) - pil.astype(int)).mean()) < 2.0
+
+
+def test_edge_shapes_and_grayscale():
+    for shape in [(7, 5), (224, 224), (1, 1), (40, 1000), (1000, 40)]:
+        data = _encode(np.full(shape + (3,), 77, np.uint8))
+        out = J.decode_resized(data, 224)
+        assert out.shape == (224, 224, 3), shape
+        # constant image survives scale+resize within JPEG tolerance
+        assert abs(int(out.mean()) - 77) <= 3, (shape, out.mean())
+    gray = _encode(np.full((64, 64), 50, np.uint8), mode="L")
+    out = J.decode_resized(gray, 96)
+    assert out.shape == (96, 96, 3)
+    assert abs(int(out.mean()) - 50) <= 3
+
+
+def test_corrupt_and_truncated_inputs_raise():
+    with pytest.raises(ValueError):
+        J.decode_rgb(b"\xff\xd8not really a jpeg at all")
+    with pytest.raises(ValueError):
+        J.decode_resized(b"", 32)
+    data = _encode(_smooth(64, 64))
+    with pytest.raises(ValueError):
+        J.decode_resized(data[: len(data) // 3], 32)
+    # truncation INSIDE the scan body: libjpeg pads a fake EOI and
+    # decodes gray with only a warning — the strict native path must
+    # reject it and PIL arbitration must also raise, never return
+    # garbage pixels (PERF-critical data-integrity contract)
+    with pytest.raises(ValueError):
+        J.decode_resized(data[: int(len(data) * 0.8)], 32)
+
+
+def test_cmyk_jpeg_decodes_via_arbitration():
+    """libjpeg can't emit RGB from CMYK sources; the strict native
+    failure must fall back to PIL (ImageNet contains CMYK JPEGs)."""
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(np.full((80, 60, 4), 120, np.uint8),
+                    mode="CMYK").save(buf, "JPEG")
+    out = J.decode_resized(buf.getvalue(), 48)
+    assert out.shape == (48, 48, 3)
+
+
+def test_batch_matches_sequential():
+    datas = [_encode(_smooth(100 + 13 * i, 90 + 7 * i)) for i in range(6)]
+    batch = J.decode_batch(datas, 64, threads=3)
+    assert batch.shape == (6, 64, 64, 3)
+    for i, d in enumerate(datas):
+        np.testing.assert_array_equal(batch[i], J.decode_resized(d, 64))
+
+
+def test_pil_fallback_path(monkeypatch):
+    """With the native lib masked, the same API runs via PIL + numpy
+    bilinear and stays close to the native output."""
+    data = _encode(_smooth(300, 400))
+    native = J.decode_resized(data, 128)
+    monkeypatch.setattr(J, "_LIB", None)
+    monkeypatch.setattr(J, "_TRIED", True)
+    assert not J.available()
+    fallback = J.decode_resized(data, 128)
+    assert fallback.shape == (128, 128, 3)
+    assert float(np.abs(native.astype(int) - fallback.astype(int)).mean()) \
+        < 2.0
+    batch = J.decode_batch([data, data], 128)
+    np.testing.assert_array_equal(batch[0], fallback)
+
+
+def test_decode_record_jpeg_routes_native():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "imagenet_records",
+        os.path.join(os.path.dirname(__file__), "..", "examples", "resnet",
+                     "imagenet_records.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    data = _encode(_smooth(256, 256))
+    img, label = mod.decode_record({"image": data, "label": 7}, 224)
+    assert img.shape == (224, 224, 3) and label == 7
