@@ -16,19 +16,9 @@ _JPEG_MAGIC = b"\xff\xd8"
 _PNG_MAGIC = b"\x89PNG"
 
 
-def decode_record(feats, image_size):
-    """Normalize one record to ``(uint8 [H, W, 3] array, 0-based int)``.
-
-    ``feats``: {name: value} or {name: [value]} (both the dfutil-loaded
-    and raw decode_example shapes).  Raises KeyError when image/label
-    fields are missing and ValueError when the payload is neither an
-    exact-size raw buffer nor JPEG/PNG — callers choose skip vs fail.
-
-    Payload rule (order matters): JPEG/PNG magic wins over the size
-    heuristic — a compressed image whose byte length happens to equal
-    H*W*3 must be decoded, not baked into the dataset as garbage
-    "raw" pixels.
-    """
+def _payload_label(feats):
+    """Field/label normalization shared by the single and batch decoders:
+    {name: value} or {name: [value]} → (image payload, 0-based label)."""
     data = feats.get("image", feats.get("image/encoded"))
     if data is None:
         raise KeyError(
@@ -47,7 +37,27 @@ def decode_record(feats, image_size):
             f"(got {sorted(feats)})")
     if isinstance(label, list):
         label = label[0]
+    return data, label
 
+
+def decode_record(feats, image_size):
+    """Normalize one record to ``(uint8 [H, W, 3] array, 0-based int)``.
+
+    ``feats``: {name: value} or {name: [value]} (both the dfutil-loaded
+    and raw decode_example shapes).  Raises KeyError when image/label
+    fields are missing and ValueError when the payload is neither an
+    exact-size raw buffer nor JPEG/PNG — callers choose skip vs fail.
+
+    Payload rule (order matters): JPEG/PNG magic wins over the size
+    heuristic — a compressed image whose byte length happens to equal
+    H*W*3 must be decoded, not baked into the dataset as garbage
+    "raw" pixels.
+    """
+    data, label = _payload_label(feats)
+    return _decode_payload(data, label, image_size)
+
+
+def _decode_payload(data, label, image_size):
     if data[:2] == _JPEG_MAGIC:
         # native libjpeg path when built (DCT-scaled decode + C resize,
         # GIL-free — recordio/jpeg.py).  The native decoder is strict;
@@ -71,3 +81,32 @@ def decode_record(feats, image_size):
         f"image payload is {raw.size} bytes: neither "
         f"{image_size}x{image_size}x3 raw uint8 nor JPEG/PNG — check "
         f"--image_size against the dataset")
+
+
+def decode_records_batch(recs, image_size, threads=None):
+    """Decode an iterable of records → [(uint8 [S,S,3], int label)],
+    routing all JPEG payloads through ONE threaded native decode
+    (recordio.jpeg.decode_batch — the C call releases the GIL, so this
+    scales with cores where the per-record loop cannot).  Raw and PNG
+    records take the per-record path.  Error TYPES match
+    ``decode_record``, but ordering differs: missing-field KeyErrors
+    and bad non-JPEG payloads surface during the normalization pre-pass
+    (before any JPEG is decoded), so with several bad records the one
+    reported may not be the positionally first."""
+    items = [_payload_label(f) for f in recs]
+    out = [None] * len(items)
+    jpeg_idx, jpeg_data = [], []
+    for i, (data, label) in enumerate(items):
+        if isinstance(data, (bytes, bytearray, memoryview)) \
+                and bytes(data[:2]) == _JPEG_MAGIC:
+            jpeg_idx.append(i)
+            jpeg_data.append(bytes(data))
+        else:
+            out[i] = _decode_payload(data, label, image_size)
+    if jpeg_idx:
+        from tensorflowonspark_tpu.recordio import jpeg as _jpeg
+
+        imgs = _jpeg.decode_batch(jpeg_data, image_size, threads=threads)
+        for k, i in enumerate(jpeg_idx):
+            out[i] = (imgs[k], int(items[i][1]))
+    return out

@@ -158,9 +158,6 @@ def _records(args, engine):
         # TF-official JPEG "image/encoded"/"image/class/label" (1-based)
         import imagenet_records
 
-        def to_row(rec):
-            return imagenet_records.decode_record(rec, image)
-
         if ds.num_partitions < args.cluster_size:
             # min_partitions striping should prevent this; keep a
             # belt-and-braces fallback for exotic sources.  Rebalances
@@ -171,9 +168,23 @@ def _records(args, engine):
                   f"{args.cluster_size} workers; repartitioning",
                   flush=True)
             ds = ds.repartition(args.cluster_size * 2)
-        return ds.map_partitions(
-            lambda it: [to_row(r) for r in it]
-        )
+        # stream the partition through the native threaded JPEG decoder
+        # in bounded chunks: the batch call amortizes thread fan-out,
+        # while chunking keeps peak memory at one chunk of encoded+
+        # decoded records instead of the whole partition at once
+        def decode_stream(it, chunk=256):
+            batch = []
+            for rec in it:
+                batch.append(rec)
+                if len(batch) >= chunk:
+                    yield from imagenet_records.decode_records_batch(
+                        batch, image)
+                    batch = []
+            if batch:
+                yield from imagenet_records.decode_records_batch(
+                    batch, image)
+
+        return ds.map_partitions(decode_stream)
     rng = np.random.default_rng(0)
     n = args.batch_size * args.steps
     pool = [rng.integers(0, 256, (args.image_size, args.image_size, 3),

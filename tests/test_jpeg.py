@@ -111,7 +111,7 @@ def test_pil_fallback_path(monkeypatch):
     np.testing.assert_array_equal(batch[0], fallback)
 
 
-def test_decode_record_jpeg_routes_native():
+def _imagenet_records():
     import importlib.util
     import os
 
@@ -121,6 +121,37 @@ def test_decode_record_jpeg_routes_native():
                      "imagenet_records.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
+    return mod
+
+
+def test_decode_record_jpeg_routes_native():
+    mod = _imagenet_records()
     data = _encode(_smooth(256, 256))
     img, label = mod.decode_record({"image": data, "label": 7}, 224)
     assert img.shape == (224, 224, 3) and label == 7
+
+
+def test_decode_records_batch_mixed_and_errors():
+    """Batch decode must match per-record decode across mixed payloads
+    (JPEG + raw + TF-official keys) and keep the first-bad-record-raises
+    contract."""
+    mod = _imagenet_records()
+    size = 64
+    raw = np.full((size, size, 3), 9, np.uint8)
+    recs = [
+        {"image": _encode(_smooth(100, 120)), "label": 1},
+        {"image": raw.tobytes(), "label": 2},
+        {"image/encoded": [_encode(_smooth(90, 70))],
+         "image/class/label": [5]},  # 1-based
+    ]
+    batch = mod.decode_records_batch(recs, size)
+    assert [lbl for _, lbl in batch] == [1, 2, 4]
+    for (img_b, _), rec in zip(batch, recs):
+        img_s, _ = mod.decode_record(rec, size)
+        np.testing.assert_array_equal(img_b, img_s)
+
+    with pytest.raises(ValueError):
+        mod.decode_records_batch(
+            [{"image": b"\xff\xd8broken", "label": 0}], size)
+    with pytest.raises(KeyError):
+        mod.decode_records_batch([{"label": 0}], size)
