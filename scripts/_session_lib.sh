@@ -33,23 +33,26 @@ session_run() {
 host_run() {
   local tmo=$1; shift
   echo "-- $* (host, timeout ${tmo}s) --" | tee -a "$log"
-  timeout "$tmo" "$@" 2>&1 | tee -a "$log"
+  timeout -k 10 "$tmo" "$@" 2>&1 | tee -a "$log"
   echo "-- rc=${PIPESTATUS[0]} --" | tee -a "$log"
 }
 
 # probe_gate — bounded liveness probe BEFORE any big compile; ABORTS
 # the session when the tunnel/pool is sick (rc 4 = relay port closed,
-# diagnosed pre-jax in ~2 s; 124 = probe hang; 2 = cpu backend;
-# 3 = wrong result).
+# diagnosed pre-jax in ~2 s; 124 = probe hang, TERM honored;
+# 137 = probe hang, TERM ignored and KILL escalated — the wedged
+# `import jax` signature; 2 = cpu backend; 3 = wrong result).
 probe_gate() {
   echo "-- tpu_probe --" | tee -a "$log"
-  timeout "${TFOS_SESSION_PROBE_TIMEOUT:-300}" python scripts/tpu_probe.py 2>&1 | tee -a "$log"
+  # -k: a probe wedged in `import jax` against a dying relay ignores
+  # TERM (observed r4) - escalate to KILL so no orphan holds the claim
+  timeout -k 10 "${TFOS_SESSION_PROBE_TIMEOUT:-300}" python scripts/tpu_probe.py 2>&1 | tee -a "$log"
   local probe_rc=${PIPESTATUS[0]}
   echo "-- rc=$probe_rc --" | tee -a "$log"
   if [ "$probe_rc" != "0" ]; then
     echo "ABORT: TPU probe failed (rc=$probe_rc; 4=relay dead, \
-124=timeout/hang, 2=cpu backend, 3=wrong result) - tunnel/pool is sick, \
-not claiming further" | tee -a "$log"
+124=timeout/hang, 137=hang+TERM-ignored(KILLed), 2=cpu backend, \
+3=wrong result) - tunnel/pool is sick, not claiming further" | tee -a "$log"
     exit "$probe_rc"
   fi
 }

@@ -1,7 +1,10 @@
 #!/bin/bash
-# Poll the axon relay port; the moment it opens, fire the given command
+# Poll the axon relay port; when it opens, fire the given command
 # (default: the round-4 follow-up session).  Round-3 lesson: a tunnel
-# that comes back mid-session must never be missed.
+# that comes back mid-session must never be missed.  Round-4 lesson:
+# the session can ABORT early (probe rc 2/3/4, relay death rc 86) and
+# the tunnel can come back AGAIN later — re-arm after failures, exit
+# only when the session completes.
 #   bash scripts/watch_tunnel.sh [cmd...]
 set -u
 cd "$(dirname "$0")/.."
@@ -12,7 +15,23 @@ while true; do
   if timeout 2 bash -c "echo > /dev/tcp/127.0.0.1/8082" 2>/dev/null; then
     echo "tunnel OPEN at $(date -u +%FT%TZ); firing"
     "${cmd[@]}"
-    exit $?
+    rc=$?
+    if [ "$rc" = "0" ]; then
+      echo "session completed rc=0 at $(date -u +%FT%TZ); watcher done"
+      exit 0
+    fi
+    if [ "$rc" = "126" ] || [ "$rc" = "127" ] || [ "$rc" = "130" ]; then
+      # broken harness / operator interrupt: deterministic, retrying
+      # would re-claim the chip every cycle for the same failure
+      echo "session failed rc=$rc (harness/interrupt) at $(date -u +%FT%TZ); NOT re-arming" 
+      exit "$rc"
+    fi
+    # aborted (sick pool / relay died mid-run / probe hang 124|137):
+    # wait out the flap, then re-arm — an open-but-sick port must not
+    # hot-loop the session
+    echo "session aborted rc=$rc at $(date -u +%FT%TZ); re-arming in 120s"
+    sleep 120
+  else
+    sleep 30
   fi
-  sleep 30
 done
