@@ -11,6 +11,7 @@ cd "$(dirname "$0")/.."
 cmd=("${@:-}")
 if [ -z "${cmd[0]:-}" ]; then cmd=(bash scripts/tpu_round4_followup.sh); fi
 echo "watching port 8082 for the tunnel; will run: ${cmd[*]}"
+fails=0
 while true; do
   if timeout 2 bash -c "echo > /dev/tcp/127.0.0.1/8082" 2>/dev/null; then
     echo "tunnel OPEN at $(date -u +%FT%TZ); firing"
@@ -28,8 +29,17 @@ while true; do
     fi
     # aborted (sick pool / relay died mid-run / probe hang 124|137):
     # wait out the flap, then re-arm — an open-but-sick port must not
-    # hot-loop the session
-    echo "session aborted rc=$rc at $(date -u +%FT%TZ); re-arming in 120s"
+    # hot-loop the session.  Capped: a DETERMINISTIC failure with a
+    # healthy port (e.g. a reproducible step crash exiting rc=1) would
+    # otherwise re-claim the chip every cycle forever.
+    fails=$((fails + 1))
+    if [ "$fails" -ge 5 ]; then
+      echo "session aborted rc=$rc; $fails consecutive failures -" \
+           "giving up (not a tunnel flap)"
+      exit "$rc"
+    fi
+    echo "session aborted rc=$rc at $(date -u +%FT%TZ); re-arming in 120s" \
+         "(attempt $fails/5)"
     sleep 120
   else
     sleep 30

@@ -164,42 +164,54 @@ def _bn_train_fused_bwd(eps, res, cts):
 _bn_train_fused.defvjp(_bn_train_fused_fwd, _bn_train_fused_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _bn_relu_train_fused(x, scale, bias, eps):
-    """BN→ReLU pair with one custom VJP over both.
+def _make_bn_act_fused(act, gate):
+    """Factory for BN→activation pairs sharing one custom VJP.
 
     Autodiff stores two activation-sized residuals per pair (x for the
-    BN backward, the pre-activation for the relu gate).  Here only x is
+    BN backward, the pre-activation for the act gate).  Here only x is
     saved; the backward recomputes the gate from x and the per-channel
     (mean, inv, scale, bias) vectors inside its existing passes — one
-    fewer activation HBM round-trip per BN→ReLU, on top of the fused-BN
+    fewer activation HBM round-trip per pair, on top of the fused-BN
     backward's two-pass structure (see ``_bn_train_fused``).
-    """
-    mean, var, inv = _bn_stats(x, eps)
-    mul, add = _bn_scale_bias(mean, inv, scale, bias, x.dtype)
-    return jnp.maximum(x * mul + add, 0), mean, var
+
+    ``act(pre)`` is the forward activation; ``gate(pre)`` its f32
+    derivative evaluated on the pre-activation recomputed EXACTLY as
+    the forward computed it (same ops, same dtype), so the subgradient
+    convention at ties is whatever ``gate`` encodes."""
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+    def bn_act(x, scale, bias, eps):
+        mean, var, inv = _bn_stats(x, eps)
+        mul, add = _bn_scale_bias(mean, inv, scale, bias, x.dtype)
+        return act(x * mul + add), mean, var
+
+    def fwd(x, scale, bias, eps):
+        mean, var, inv = _bn_stats(x, eps)
+        mul, add = _bn_scale_bias(mean, inv, scale, bias, x.dtype)
+        return (act(x * mul + add), mean, var), (x, mean, inv, scale, bias)
+
+    def bwd(eps, res, cts):
+        x, mean, inv, scale, bias = res
+        g, mean_ct, var_ct = cts
+        mul, add = _bn_scale_bias(mean, inv, scale, bias, x.dtype)
+        gm = g.astype(jnp.float32) * gate(x * mul + add)
+        return _bn_bwd_core(gm, x, mean, inv, scale, mean_ct, var_ct)
+
+    bn_act.defvjp(fwd, bwd)
+    return bn_act
 
 
-def _bn_relu_train_fused_fwd(x, scale, bias, eps):
-    mean, var, inv = _bn_stats(x, eps)
-    mul, add = _bn_scale_bias(mean, inv, scale, bias, x.dtype)
-    y = jnp.maximum(x * mul + add, 0)
-    return (y, mean, var), (x, mean, inv, scale, bias)
-
-
-def _bn_relu_train_fused_bwd(eps, res, cts):
-    x, mean, inv, scale, bias = res
-    g, mean_ct, var_ct = cts
-    # recompute the pre-activation exactly as the forward did (same ops,
-    # same dtype) instead of storing it; sign() reproduces jnp.maximum's
-    # tie convention (gradient 1/2 where the pre-activation is exactly 0)
-    mul, add = _bn_scale_bias(mean, inv, scale, bias, x.dtype)
-    gate = (jnp.sign((x * mul + add).astype(jnp.float32)) + 1.0) * 0.5
-    return _bn_bwd_core(g.astype(jnp.float32) * gate, x, mean, inv, scale,
-                        mean_ct, var_ct)
-
-
-_bn_relu_train_fused.defvjp(_bn_relu_train_fused_fwd, _bn_relu_train_fused_bwd)
+# relu: sign() reproduces jnp.maximum's tie convention (gradient 1/2
+# where the pre-activation is exactly 0)
+_bn_relu_train_fused = _make_bn_act_fused(
+    lambda pre: jnp.maximum(pre, 0),
+    lambda pre: (jnp.sign(pre.astype(jnp.float32)) + 1.0) * 0.5)
+# relu6 (MobileNet-style blocks): jax.nn.relu6's gradient is 0 at BOTH
+# saturation boundaries (strict inequalities)
+_bn_relu6_train_fused = _make_bn_act_fused(
+    jax.nn.relu6,
+    lambda pre: ((pre.astype(jnp.float32) > 0)
+                 & (pre.astype(jnp.float32) < 6)).astype(jnp.float32))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
@@ -280,19 +292,33 @@ def batchnorm(params, state, x, train=True, momentum=0.9, eps=1e-5,
     return x * mul + add, state
 
 
-def batchnorm_relu(params, state, x, train=True, momentum=0.9, eps=1e-5,
-                   fused=True):
-    """BatchNorm followed by ReLU.  In fused training mode the pair
-    shares one custom VJP (``_bn_relu_train_fused``) that stores no
-    pre-activation residual; otherwise it is exactly
-    ``relu(batchnorm(...))``.  Returns (y, new_state)."""
+def _batchnorm_act(fused_core, act, params, state, x, train, momentum,
+                   eps, fused):
     if train and fused:
-        y, mean, var = _bn_relu_train_fused(
-            x, params["scale"], params["bias"], eps)
+        y, mean, var = fused_core(x, params["scale"], params["bias"], eps)
         return y, _ema_state(state, mean, var, momentum)
     y, new_state = batchnorm(params, state, x, train=train,
                              momentum=momentum, eps=eps, fused=fused)
-    return relu(y), new_state
+    return act(y), new_state
+
+
+def batchnorm_relu(params, state, x, train=True, momentum=0.9, eps=1e-5,
+                   fused=True):
+    """BatchNorm followed by ReLU.  In fused training mode the pair
+    shares one custom VJP (``_make_bn_act_fused``) that stores no
+    pre-activation residual; otherwise it is exactly
+    ``relu(batchnorm(...))``.  Returns (y, new_state)."""
+    return _batchnorm_act(_bn_relu_train_fused, relu, params, state, x,
+                          train, momentum, eps, fused)
+
+
+def batchnorm_relu6(params, state, x, train=True, momentum=0.9, eps=1e-5,
+                    fused=True):
+    """BatchNorm followed by ReLU6 (MobileNet-style blocks); fused
+    training mode shares one custom VJP, otherwise exactly
+    ``jax.nn.relu6(batchnorm(...))``.  Returns (y, new_state)."""
+    return _batchnorm_act(_bn_relu6_train_fused, jax.nn.relu6, params,
+                          state, x, train, momentum, eps, fused)
 
 
 def batchnorm_add_relu(params, state, x, shortcut, train=True, momentum=0.9,

@@ -57,11 +57,11 @@ def _invres_apply(p, s, x, stride, train):
     y = x
     if "expand" in p:
         y = L.conv(p["expand"], y)
-        y, ns["bn_e"] = L.batchnorm(p["bn_e"], s["bn_e"], y, train)
-        y = jax.nn.relu6(y)
+        # fused BN→ReLU6 pair: no stored pre-activation residual in
+        # the backward (layers.batchnorm_relu6)
+        y, ns["bn_e"] = L.batchnorm_relu6(p["bn_e"], s["bn_e"], y, train)
     y = _dwconv(p["dw"], y, stride=stride)
-    y, ns["bn_d"] = L.batchnorm(p["bn_d"], s["bn_d"], y, train)
-    y = jax.nn.relu6(y)
+    y, ns["bn_d"] = L.batchnorm_relu6(p["bn_d"], s["bn_d"], y, train)
     y = L.conv(p["project"], y)
     y, ns["bn_p"] = L.batchnorm(p["bn_p"], s["bn_p"], y, train)
     if stride == 1 and x.shape[-1] == y.shape[-1]:
@@ -124,8 +124,8 @@ def apply(params, state, x, train=False):
     H and W must be divisible by 2**(#stride-2 stages + stem)."""
     ns = {}
     y = L.conv(params["stem"], x, stride=2)
-    y, ns["bn_stem"] = L.batchnorm(params["bn_stem"], state["bn_stem"], y, train)
-    y = jax.nn.relu6(y)
+    y, ns["bn_stem"] = L.batchnorm_relu6(
+        params["bn_stem"], state["bn_stem"], y, train)
 
     taps = []
     for i, (_, stride, _) in enumerate(_ENCODER):

@@ -214,6 +214,43 @@ def test_batchnorm_relu_fused_vjp_parity():
     np.testing.assert_allclose(ye, yep, rtol=0, atol=0)
 
 
+def test_batchnorm_relu6_fused_vjp_parity():
+    """BN→ReLU6 fused VJP vs jax.nn.relu6(batchnorm(...)): value,
+    stats, gradients — including both saturation boundaries, where
+    jax.nn.relu6's gradient is exactly 0 (strict inequalities)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import layers as L
+
+    key = jax.random.PRNGKey(11)
+    x = jax.random.normal(key, (6, 4, 4, 8), jnp.float32) * 4.0 + 2.0
+    # scale=0/bias=0 -> pre==0 everywhere on ch 1 (lower tie);
+    # scale=0/bias=6 -> pre==6 everywhere on ch 5 (upper tie)
+    params = {"scale": jnp.linspace(0.5, 2.0, 8).at[1].set(0.0).at[5].set(0.0),
+              "bias": jnp.zeros(8).at[5].set(6.0)}
+    state = {"mean": jnp.zeros(8), "var": jnp.ones(8)}
+
+    def loss(p, x, fused):
+        y, new = L.batchnorm_relu6(p, state, x, train=True, fused=fused)
+        return (jnp.sum(jnp.tanh(y)) + jnp.sum(new["mean"])
+                + jnp.sum(new["var"]))
+
+    y_f, new_f = L.batchnorm_relu6(params, state, x, train=True, fused=True)
+    y_p, new_p = L.batchnorm_relu6(params, state, x, train=True, fused=False)
+    np.testing.assert_allclose(y_f, y_p, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(new_f["mean"], new_p["mean"], rtol=1e-6)
+    assert float(jnp.min(y_f)) >= 0.0 and float(jnp.max(y_f)) <= 6.0
+
+    gf = jax.grad(loss, argnums=(0, 1))(params, x, True)
+    gp = jax.grad(loss, argnums=(0, 1))(params, x, False)
+    np.testing.assert_allclose(gf[0]["scale"], gp[0]["scale"], rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(gf[0]["bias"], gp[0]["bias"], rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(gf[1], gp[1], rtol=1e-5, atol=1e-5)
+
+
 def test_batchnorm_add_relu_fused_vjp_parity():
     """relu(bn(x) + shortcut) fused VJP vs the plain path: value,
     running stats, and gradients for x, shortcut, scale, bias —
