@@ -329,37 +329,182 @@ def bench_config_path():
         os.path.dirname(os.path.abspath(__file__)), "bench_config.json")
 
 
-def _tunnel_note():
-    """Pre-jax diagnosis of the axon relay: when the loopback tunnel is
-    dead, `import jax` HANGS (the site hook dials the pool at interpreter
-    startup), so an unattended bench run dies as an opaque rc=124 with no
-    explanation (round-3 failure mode: BENCH_r03 was exactly that).
-    Print the diagnosis to stderr BEFORE any jax import so the round's
-    bench log says why; TFOS_BENCH_REQUIRE_TUNNEL=1 additionally aborts
-    fast (rc=3) instead of hanging for the driver's whole timeout."""
-    import socket
-    import sys
+def _failsafe_line(error, **extra):
+    """THE one JSON line, fail-safe form: value null + an error string.
+    The driver parses the last stdout line of every round-end bench run;
+    a dead tunnel must still produce a parseable artifact (rounds 3 AND 4
+    both ended rc=124/parsed=null instead — VERDICT r4 weak #2)."""
+    print(json.dumps({
+        "metric": "resnet50_train_mfu",
+        "value": None,
+        "unit": "fraction_of_peak",
+        "vs_baseline": None,
+        "error": error,
+        "extra": extra,
+    }), flush=True)
 
+
+def _tunnel_in_play():
+    """True when this process would dial the axon TPU tunnel at jax
+    import/init time (the site hook on PYTHONPATH dials the pool at
+    interpreter startup; `import jax` HANGS — not errors — if the relay
+    is dead)."""
     if os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip() == "cpu":
-        return  # explicit CPU run: the tunnel is irrelevant
-    if "axon" not in os.environ.get("PYTHONPATH", "").lower() and \
-            not os.environ.get("PALLAS_AXON_POOL_IPS"):
-        return  # no tunnel in play (CI)
-    host = os.environ.get("PALLAS_AXON_POOL_IPS", "127.0.0.1").split(",")[0]
-    port = int(os.environ.get("TFOS_TUNNEL_PORT", "8082"))
+        return False  # explicit CPU run: the tunnel is irrelevant
+    return "axon" in os.environ.get("PYTHONPATH", "").lower() or \
+        bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+
+
+def _probe_relay(host, port):
+    import socket
+
     try:
         with socket.create_connection((host, port), timeout=2):
-            return  # relay listening: proceed normally
+            return True
     except OSError:
-        pass
+        return False
+
+
+def _tunnel_note():
+    """Pre-jax diagnosis of the axon relay.  When the loopback tunnel is
+    dead, `import jax` HANGS, so an unattended bench run dies as an
+    opaque rc=124 with no artifact (the round-3 AND round-4 failure
+    mode).  Fail-safe is now the DEFAULT: after a short re-probe grace
+    window (TFOS_BENCH_TUNNEL_WAIT, default 20s — it must beat
+    with_tunnel_watchdog.sh's ~45s SIGKILL) the bench emits its one
+    JSON line with value null + "error":"tunnel_dead" and exits 0 —
+    well under 2 minutes, no env opt-in needed.  Set
+    TFOS_BENCH_IGNORE_TUNNEL=1 to restore the old press-on behavior."""
+    import sys
+
+    if not _tunnel_in_play():
+        return  # no tunnel in play (CI / explicit CPU)
+    host = os.environ.get("PALLAS_AXON_POOL_IPS", "127.0.0.1").split(",")[0]
+    port = int(os.environ.get("TFOS_TUNNEL_PORT", "8082"))
+    if _probe_relay(host, port):
+        return  # relay listening: proceed normally
     print(f"bench: WARNING axon relay {host}:{port} is not listening - "
-          "the TPU tunnel looks DEAD; jax backend init will likely hang "
-          "(this is the round-3 rc=124 failure mode)",
+          "the TPU tunnel looks DEAD; jax backend init would hang "
+          "(the round-3/round-4 rc=124 failure mode)",
           file=sys.stderr, flush=True)
-    if os.environ.get("TFOS_BENCH_REQUIRE_TUNNEL") == "1":
-        print("bench: TFOS_BENCH_REQUIRE_TUNNEL=1 - aborting fast",
+    if os.environ.get("TFOS_BENCH_IGNORE_TUNNEL") == "1":
+        print("bench: TFOS_BENCH_IGNORE_TUNNEL=1 - pressing on anyway",
               file=sys.stderr, flush=True)
-        raise SystemExit(3)
+        return
+    # default grace must finish BEFORE scripts/with_tunnel_watchdog.sh's
+    # SIGKILL (4 failed probes at 15s intervals, ~45-60s): a session-run
+    # bench must get its fail-safe line out ahead of the outer kill
+    grace = float(os.environ.get("TFOS_BENCH_TUNNEL_WAIT", "20"))
+    deadline = time.monotonic() + grace
+    while time.monotonic() < deadline:
+        time.sleep(5)
+        if _probe_relay(host, port):
+            print("bench: relay came back during the grace window",
+                  file=sys.stderr, flush=True)
+            return
+    print(f"bench: relay still dead after {grace:.0f}s grace - emitting "
+          "the fail-safe line and exiting", file=sys.stderr, flush=True)
+    _failsafe_line("tunnel_dead", relay=f"{host}:{port}")
+    raise SystemExit(0)
+
+
+def _arm_init_watchdog(cleanup=None):
+    """A relay that dies BETWEEN the probe and backend init still wedges
+    `import jax` / `jax.devices()` for the driver's whole timeout (r4
+    lost 26 min to exactly this, tail 09:22->09:48).  Arm a daemon timer
+    before the jax import: if backend init hasn't completed within
+    TFOS_BENCH_INIT_TIMEOUT (default 900s — cold tunnel init is minutes,
+    never 15), print the fail-safe JSON line and hard-exit.  A wedged
+    jax ignores SIGTERM (memory: round-4), so os._exit is the only
+    reliable escape from inside the process — which skips
+    multiprocessing's atexit teardown, so ``cleanup`` must reap anything
+    spawned earlier (the fed feeder/manager children + shm rings)."""
+    import threading
+
+    if not _tunnel_in_play():
+        return lambda: None, lambda: None
+    cap = float(os.environ.get("TFOS_BENCH_INIT_TIMEOUT", "900"))
+    host = os.environ.get("PALLAS_AXON_POOL_IPS", "127.0.0.1").split(",")[0]
+    port = int(os.environ.get("TFOS_TUNNEL_PORT", "8082"))
+    done = threading.Event()
+    deadline = [time.monotonic() + cap]
+
+    def extend(horizon=0.0):
+        # re-arm per init attempt: the UNAVAILABLE retry schedule sleeps
+        # 60+120+180s by design, so one fixed cap spanning all attempts
+        # would kill the exact runs the retries were built to save.
+        # ``horizon`` covers a planned sleep longer than the cap itself.
+        deadline[0] = time.monotonic() + max(cap, horizon)
+
+    def fire(error, **extra):
+        import sys
+
+        print(f"bench: init watchdog firing ({error}); emitting the "
+              "fail-safe line", file=sys.stderr, flush=True)
+        _failsafe_line(error, **extra)
+        if cleanup is not None:
+            try:
+                cleanup()
+            except Exception:  # noqa: BLE001 - exiting anyway
+                pass
+        os._exit(0)
+
+    def watchdog():
+        # two triggers: the per-attempt time cap (a wedge against a SICK
+        # tunnel whose port still listens), and the relay port closing
+        # mid-init (the r4 post-probe death mode).  The port trigger must
+        # fire FAST: under the session harness with_tunnel_watchdog.sh
+        # SIGKILLs the whole group ~45-60s after the ports close, and the
+        # fail-safe line has to be out before that.
+        port_down = 0
+        while not done.wait(min(5.0, cap)):
+            port_down = 0 if _probe_relay(host, port) else port_down + 1
+            if port_down >= 3:  # ~15-21s of consecutive closed probes
+                fire("tunnel_died_during_init", relay=f"{host}:{port}")
+            if time.monotonic() >= deadline[0]:
+                fire("backend_init_timeout", timeout_s=cap)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    return done.set, extend
+
+
+def _init_failsafe(e):
+    """One place for every backend-init failure: with a tunnel in play,
+    emit the parseable fail-safe line (the unattended-round contract)
+    and exit 0; without one (CPU/CI), re-raise so a genuine code failure
+    keeps its traceback and nonzero rc.  The traceback is printed to
+    stderr either way — a null artifact must still be debuggable."""
+    import sys
+    import traceback
+
+    traceback.print_exc(file=sys.stderr)
+    sys.stderr.flush()
+    if not _tunnel_in_play():
+        raise e
+    _failsafe_line("backend_init_failed", detail=str(e)[:200])
+    raise SystemExit(0)
+
+
+def _fed_teardown(*ctxs):
+    """Reap a fed lane's children + shm ring without relying on atexit
+    (the watchdog's os._exit path skips it): kill the feeder, close the
+    ring (creator close unlinks the segment), shut the manager server
+    down."""
+    for fed in ctxs:
+        if not isinstance(fed, dict) or "proc" not in fed:
+            continue
+        try:
+            fed["proc"].kill()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            fed["ring"].close()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            fed["mgr"].shutdown()
+        except Exception:  # noqa: BLE001
+            pass
 
 
 def _promoted_config():
@@ -425,17 +570,28 @@ def main():
             except Exception as e:  # noqa: BLE001
                 fed_ctx_rows = {"setup_error": str(e)[:200]}
 
-    import jax
-    import jax.numpy as jnp
-    import optax
+    # the watchdog covers the import AND every init attempt below: any
+    # wedge against a dying tunnel ends in a parseable fail-safe line
+    # (and reaps the already-spawned fed children before the hard exit)
+    _init_done, _init_extend = _arm_init_watchdog(
+        cleanup=lambda: _fed_teardown(fed_ctx, fed_ctx_rows))
 
-    from tensorflowonspark_tpu.models import resnet
+    try:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from tensorflowonspark_tpu.models import resnet
+    except Exception as e:  # noqa: BLE001 - e.g. ConnectionRefusedError
+        # from the site hook once the relay ports close (r4's ending)
+        _init_failsafe(e)
 
     # backend init retry: a TPU pool can answer UNAVAILABLE transiently
     # (observed: tunnel claim errors that clear after minutes) — one
     # retry cycle is cheap insurance for an unattended bench run
     dev = None
     for attempt in range(int(os.environ.get("TFOS_BENCH_INIT_RETRIES", "3"))):
+        _init_extend()  # fresh watchdog budget per attempt
         try:
             dev = jax.devices()[0]
             break
@@ -443,7 +599,7 @@ def main():
             import sys
 
             if "UNAVAILABLE" not in str(e):
-                raise  # permanent misconfiguration: fail fast
+                _init_failsafe(e)  # permanent misconfiguration
             print(f"bench: backend init failed (try {attempt + 1}): "
                   f"{str(e)[:120]}", file=sys.stderr, flush=True)
             try:  # drop the cached failure so the next call re-dials
@@ -452,9 +608,17 @@ def main():
                 _xb._clear_backends()
             except Exception:  # noqa: BLE001 - internal API may move
                 pass
-            time.sleep(60 * (attempt + 1))
+            backoff = 60 * (attempt + 1)
+            _init_extend(backoff + 60)  # keep the watchdog clear of the
+            time.sleep(backoff)         # deliberate backoff sleep
+        except Exception as e:  # noqa: BLE001 - non-Runtime init failure
+            _init_failsafe(e)
     if dev is None:
-        dev = jax.devices()[0]  # final attempt; let the real error surface
+        try:
+            dev = jax.devices()[0]  # final attempt
+        except Exception as e:  # noqa: BLE001
+            _init_failsafe(e)
+    _init_done()
     guessed_tpu = on_tpu
     on_tpu = dev.platform != "cpu"
     if on_tpu != guessed_tpu:

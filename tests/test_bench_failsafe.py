@@ -1,0 +1,139 @@
+"""bench.py must produce a parseable artifact even when the TPU tunnel
+is dead (VERDICT r4 weak #2: BENCH_r03 AND BENCH_r04 both ended rc=124
+with parsed=null because a dead relay wedged jax backend init for the
+driver's whole timeout).
+
+Fail-safe is the DEFAULT now: a dead relay yields the one JSON line with
+value null + "error":"tunnel_dead" within the grace window (no env
+opt-in), and a post-probe wedge is cut by the init watchdog.  These
+tests run the real bench.py as a subprocess with a simulated dead relay
+(an "axon" entry on PYTHONPATH engages the tunnel heuristics; the probe
+port is a closed localhost port).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _closed_port():
+    # bind-then-close: nothing listens on it afterwards
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _dead_tunnel_env(**extra):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("TFOS_", "JAX_", "XLA_", "PALLAS_"))}
+    env.update(
+        # the substring check in bench._tunnel_in_play; the path does not
+        # exist, so no real site hook runs in the child
+        PYTHONPATH="/nonexistent/axon_site_for_test",
+        TFOS_TUNNEL_PORT=str(_closed_port()),
+        TFOS_BENCH_TUNNEL_WAIT="1",
+    )
+    env.update(extra)
+    return env
+
+
+def _last_json_line(stdout):
+    lines = [ln for ln in stdout.strip().splitlines() if ln.startswith("{")]
+    assert lines, f"no JSON line in stdout: {stdout!r}"
+    return json.loads(lines[-1])
+
+
+def test_dead_relay_emits_failsafe_line_fast():
+    """The driver's round-end contract: dead tunnel -> rc=0 + one JSON
+    line (value null, error tunnel_dead) in well under 2 minutes."""
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        cwd=REPO, env=_dead_tunnel_env(), capture_output=True, text=True,
+        timeout=120)
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert elapsed < 110, f"fail-safe exit took {elapsed:.0f}s"
+    line = _last_json_line(proc.stdout)
+    assert line["metric"] == "resnet50_train_mfu"
+    assert line["value"] is None and line["vs_baseline"] is None
+    assert line["error"] == "tunnel_dead"
+    assert "not listening" in proc.stderr
+
+
+@pytest.mark.slow
+def test_dead_relay_ignore_env_presses_on():
+    """TFOS_BENCH_IGNORE_TUNNEL=1 restores the old press-on behavior
+    (needed when the operator KNOWS the probe heuristic is wrong).  With
+    JAX_PLATFORMS=cpu downstream the run then proceeds as a CPU bench;
+    here we only assert it gets PAST the tunnel gate (no tunnel_dead
+    exit) — the fed/compute lanes are covered by the slow-lane smoke."""
+    env = _dead_tunnel_env(
+        TFOS_BENCH_IGNORE_TUNNEL="1",
+        # keep the child cheap and deterministic: skip every lane, and
+        # the tunnel gate must have run BEFORE jax init (cpu platform)
+        TFOS_BENCH_FED="0", TFOS_BENCH_TRANSFORMER="0",
+        TFOS_BENCH_TFRECORD_READ="0", TFOS_BENCH_SEGMENTATION="0",
+        TFOS_BENCH_BATCH_INFERENCE="0", TFOS_BENCH_STEPS="1",
+    )
+    # note: JAX_PLATFORMS stays unset so the gate engages; the fake
+    # PYTHONPATH hook does not exist, so jax falls back to CPU
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "pressing on anyway" in proc.stderr
+    line = _last_json_line(proc.stdout)
+    assert line.get("error") != "tunnel_dead"
+    assert line["value"] is not None
+
+
+def test_init_watchdog_fires_on_relay_death():
+    """Relay alive at probe time, dead during init (the r4 post-probe
+    death mode): the port trigger must fire in ~15-21s — ahead of
+    with_tunnel_watchdog.sh's ~45-60s SIGKILL — not wait out the 900s
+    init cap."""
+    code = (
+        "import sys, time; sys.path.insert(0, %r); import bench; "
+        "bench._arm_init_watchdog(); time.sleep(120)" % REPO)
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO,
+        env=_dead_tunnel_env(),  # default TFOS_BENCH_INIT_TIMEOUT (900s)
+        capture_output=True, text=True, timeout=90)
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert elapsed < 40, f"port trigger took {elapsed:.0f}s"
+    line = _last_json_line(proc.stdout)
+    assert line["error"] == "tunnel_died_during_init"
+    assert line["value"] is None
+
+
+def test_init_watchdog_fires_on_wedge():
+    """A relay that dies between probe and backend init wedges the jax
+    import (r4: 26 min inside the driver timeout).  The watchdog must
+    emit the fail-safe line and hard-exit 0."""
+    code = (
+        "import sys, time; sys.path.insert(0, %r); import bench; "
+        "bench._arm_init_watchdog(); time.sleep(60)" % REPO)
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO,
+        env=_dead_tunnel_env(TFOS_BENCH_INIT_TIMEOUT="1"),
+        capture_output=True, text=True, timeout=60)
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert elapsed < 30, f"watchdog exit took {elapsed:.0f}s"
+    line = _last_json_line(proc.stdout)
+    assert line["error"] == "backend_init_timeout"
+    assert line["value"] is None
+    assert "watchdog firing" in proc.stderr
