@@ -15,6 +15,7 @@ the caller's number).
 
 from __future__ import annotations
 
+import functools
 import logging
 import os
 import time
@@ -63,6 +64,46 @@ def transformer_flops_per_token(cfg, causal=False):
     if causal:
         attn //= 2
     return 6 * n_params + attn
+
+
+def segmentation_flops_per_image(image_size=256, num_classes=21, width=1.0):
+    """Forward-pass FLOPs per image for models/segmentation.py, counted
+    shape-exactly from the traced program (utils.flops walks the jaxpr;
+    2 FLOPs/MAC, transposed-conv zero positions excluded).  Multiply by
+    3 for the train step like resnet.flops_per_image's callers.  Tracing
+    is abstract (eval_shape) — no device compute, safe pre-backend."""
+    return _seg_flops_cached(int(image_size), int(num_classes), float(width))
+
+
+@functools.lru_cache(maxsize=8)
+def _seg_flops_cached(image_size, num_classes, width):
+    import jax
+
+    from tensorflowonspark_tpu.models import segmentation
+    from tensorflowonspark_tpu.utils import flops as F
+
+    ps, ss = jax.eval_shape(
+        lambda k: segmentation.init(k, num_classes=num_classes, width=width),
+        jax.random.PRNGKey(0))
+    img = jax.ShapeDtypeStruct((1, image_size, image_size, 3), "float32")
+    return F.count_flops(
+        lambda p, s, x: segmentation.apply(p, s, x, train=True)[0],
+        ps, ss, img)["flops"]
+
+
+@functools.lru_cache(maxsize=1)
+def mnist_inference_flops_per_row():
+    """Forward-pass FLOPs per row for the MNIST export model that
+    BASELINE config #5 (batch inference) serves — the jittable core
+    ``mnist.apply``, counted like segmentation_flops_per_image."""
+    import jax
+
+    from tensorflowonspark_tpu.models import mnist
+    from tensorflowonspark_tpu.utils import flops as F
+
+    params = jax.eval_shape(mnist.init_params, jax.random.PRNGKey(0))
+    x = jax.ShapeDtypeStruct((1, 28, 28, 1), "float32")
+    return F.count_flops(mnist.apply, params, x)["flops"]
 
 
 class TrainMetrics:
