@@ -87,6 +87,8 @@ def decode_rgb(data, target_min=0):
     lib = _load()
     if lib is None:
         return _pil_decode(data)
+    if not isinstance(data, bytes):
+        data = bytes(data)  # ctypes c_char_p rejects bytearray/memoryview
     w = ctypes.c_int()
     h = ctypes.c_int()
     if lib.tfos_jpeg_info(data, len(data), int(target_min),
@@ -136,6 +138,8 @@ def decode_resized(data, size, _out=None):
     lib = _load()
     if lib is None:
         return _resize_bilinear(_pil_decode(data), size)
+    if not isinstance(data, bytes):
+        data = bytes(data)  # ctypes c_char_p rejects bytearray/memoryview
     w = ctypes.c_int()
     h = ctypes.c_int()
     out = _out if _out is not None else np.empty((size, size, 3), np.uint8)

@@ -46,6 +46,18 @@ def test_parity_with_pil_on_smooth_image():
     assert float(np.abs(nat.astype(int) - pil.astype(int)).mean()) < 2.0
 
 
+def test_bytearray_and_memoryview_payloads():
+    """The single-record paths must accept bytes-like payloads the way
+    the batch path always did: a TFRecord Example's bytes feature can
+    surface as bytearray/memoryview, and ctypes c_char_p takes only
+    bytes (ADVICE r4)."""
+    data = _encode(_smooth(60, 44))
+    want = J.decode_rgb(data)
+    for form in (bytearray(data), memoryview(data)):
+        assert np.array_equal(J.decode_rgb(form), want)
+        assert J.decode_resized(form, 32).shape == (32, 32, 3)
+
+
 def test_edge_shapes_and_grayscale():
     for shape in [(7, 5), (224, 224), (1, 1), (40, 1000), (1000, 40)]:
         data = _encode(np.full(shape + (3,), 77, np.uint8))
