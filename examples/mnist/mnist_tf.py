@@ -25,9 +25,13 @@ def main_fun(args, ctx):
     env = ctx.jax_initialize()
     mesh = make_mesh({"data": -1})
 
-    # host-sharded input pipeline: each worker owns a disjoint slice
+    # host-sharded input pipeline: each process owns a disjoint slice.
+    # Shard by the contiguous SPMD process id, NOT ctx.task_index —
+    # task_index is per-job, so with master_node="chief" chief:0 and
+    # worker:0 would both read shard 0 and one shard would go unread.
     images, labels = synthetic_mnist(args["num_examples"], seed=0)
-    shard = np.arange(len(images)) % ctx.num_workers == ctx.task_index
+    shard = (np.arange(len(images)) % env["num_processes"]
+             == env["process_id"])
     images, labels = images[shard], labels[shard]
 
     params = mnist.init_params(jax.random.PRNGKey(0))
