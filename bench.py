@@ -772,7 +772,7 @@ def _transformer_bench(dev, on_tpu):
         # forward failed on this backend.
         cfg = transformer.Config(
             vocab_size=16384, dim=1024, n_layers=8, n_heads=8,
-            max_seq=2048, dtype="bfloat16",
+            max_seq=int(promoted.get("seq", 2048)), dtype="bfloat16",
             attn_impl=promoted.get("attn", "flash"),
         )
         batch, steps = int(promoted.get("batch", 8)), 10
@@ -963,8 +963,17 @@ def _segmentation_bench(dev, on_tpu):
         return losses[-1]
 
     dt, loss = _time_scanned(run, params, state, opt_state, images, masks)
+    from tensorflowonspark_tpu.utils import metrics as M
+
+    ips = batch * steps / dt
+    # MFU counts fwd+bwd ≈ 3x forward (resnet-lane convention); the
+    # reported flops field stays forward-only to match the
+    # metrics.segmentation_flops_per_image helper and flops_per_row
+    fwd_flops = M.segmentation_flops_per_image(size, num_classes=21)
     return {
-        "images_per_sec_per_chip": round(batch * steps / dt, 1),
+        "images_per_sec_per_chip": round(ips, 1),
+        "mfu": round(ips * 3.0 * fwd_flops / _peak_flops(dev), 4),
+        "fwd_flops_per_image": fwd_flops,
         "batch": batch, "image": size, "steps": steps, "loss": loss,
     }
 
@@ -1004,8 +1013,14 @@ def _inference_bench(dev, on_tpu):
         out = run(iter(rows))
         dt = time.perf_counter() - t0
         assert len(out) == n_rows and "pred" in out[0]
-        return {"rows_per_sec": round(n_rows / dt, 1), "rows": n_rows,
-                "batch": 1024}
+        from tensorflowonspark_tpu.utils import metrics as M
+
+        rps = n_rows / dt
+        flops = M.mnist_inference_flops_per_row()  # forward only
+        return {"rows_per_sec": round(rps, 1),
+                "mfu": round(rps * flops / _peak_flops(dev), 6),
+                "fwd_flops_per_row": flops,
+                "rows": n_rows, "batch": 1024}
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 

@@ -127,6 +127,12 @@ def main():
         if roof.get("suspect"):
             print(f"ignoring {args.roofline}: marked suspect "
                   f"{roof['suspect']} (timing path compromised)")
+        elif roof.get("platform") not in (None, "tpu"):
+            # a smoke/dev-box roofline (platform cpu) must not pose as
+            # the chip floors: CPU GB/s are far BELOW the ceilings, so
+            # the physics guard alone would accept them
+            print(f"ignoring {args.roofline}: platform "
+                  f"{roof.get('platform')!r} is not a TPU measurement")
         elif roof.get("elementwise_gbs", 0) > max_gbs \
                 or roof.get("matmul_bf16_tflops", 0) > max_tflops:
             print(f"ignoring {args.roofline}: values exceed datasheet "

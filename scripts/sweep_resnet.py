@@ -33,6 +33,15 @@ CONFIGS = [
     ("b256_s2d", 256, True, False, False),
     ("b512_s2d_remat_bnf", 512, True, True, True),
     ("b256_7x7_bnf", 256, False, False, True),
+    # r5 structural probes: r4 measured per-image throughput FALLING
+    # with batch (256: 2581, 384: 2494, 512: 2444 img/s) on an
+    # HBM-bound step — if capacity pressure (spills/copies) is the
+    # cause, SMALLER batches should run faster per image; and remat,
+    # which lost 25% with autodiff BN, re-enters with the fused-BN
+    # backward's cheaper recompute.
+    ("b128_s2d_bnf", 128, True, False, True),
+    ("b192_s2d_bnf", 192, True, False, True),
+    ("b256_s2d_remat_bnf", 256, True, True, True),
 ]
 
 

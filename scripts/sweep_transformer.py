@@ -16,10 +16,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
 
-# (name, batch, block_q, block_kv, remat, bwd, ce) — module-level so
-# dry-run tests can substitute tiny shapes while driving the REAL
+# (name, batch, block_q, block_kv, remat, bwd, ce[, seq]) — module-level
+# so dry-run tests can substitute tiny shapes while driving the REAL
 # promote paths.  ce: "dense" | "block" (blockwise streamed CE — no
 # [B,S,V] logits tensor, buys batch headroom without full remat).
+# seq defaults to 2048 (the bench flagship); long-seq configs append an
+# explicit seq — rope is position-parameterized so params are shared.
 CONFIGS = [
     ("b16_q512_kv512", 16, 512, 512, False, "xla", "dense"),
     ("b16_q512_kv512_pbwd", 16, 512, 512, False, "pallas", "dense"),
@@ -50,6 +52,13 @@ CONFIGS = [
     ("b64_q512_kv512_rdots_pbwd", 64, 512, 512, "dots", "pallas", "dense"),
     ("b96_q512_kv512_rdots_pbwd", 96, 512, 512, "dots", "pallas", "dense"),
     ("b96_q512_kv512_remat_pbwd", 96, 512, 512, True, "pallas", "dense"),
+    # r5: seq 4096 — the regime blockwise CE exists for (VERDICT r4 #8:
+    # at seq 2048 it merely loses ~3%; at 4096 the dense [B,S,V] logits
+    # tensor doubles while blockwise stays O(block)).  Same token count
+    # as the b32/s2048 winner; direct dense-vs-bce A/B at each batch.
+    ("b16_s4096_remat_pbwd_bce", 16, 512, 512, True, "pallas", "block", 4096),
+    ("b16_s4096_remat_pbwd", 16, 512, 512, True, "pallas", "dense", 4096),
+    ("b32_s4096_remat_pbwd_bce", 32, 512, 512, True, "pallas", "block", 4096),
 ]
 
 
@@ -151,31 +160,41 @@ def main():
             attn_base, attn_name = ops.mha_reference, "reference"
             break
 
-    configs = list(CONFIGS)
+    # normalize to 8-tuples (seq defaults to the flagship 2048)
+    configs = [(*c, cfg.max_seq) if len(c) == 7 else tuple(c)
+               for c in CONFIGS]
     subset = os.environ.get("TFOS_SWEEP")
     if subset:
         want = set(subset.split(","))
         configs = [c for c in configs if c[0] in want]
     if tiny:  # plumbing check (CPU): tiny batch, blocks fitting
-        # max_seq, always including one remat, one pallas-bwd, and one
-        # blockwise-CE config
+        # max_seq, always including one remat, one pallas-bwd, one
+        # blockwise-CE, and one long-seq config
         picked = (configs[:2] + [c for c in configs[2:] if c[4]][:1]
                   + [c for c in configs[2:] if c[5] == "pallas"][:1]
-                  + [c for c in configs[2:] if c[6] == "block"][:1])
-        configs = [(n, 1, min(bq, 128), min(bkv, 128), r, bw, ce)
-                   for n, _, bq, bkv, r, bw, ce in picked]
+                  + [c for c in configs[2:] if c[6] == "block"][:1]
+                  + [c for c in configs[2:] if c[7] != cfg.max_seq][:1])
+        configs = [(n, 1, min(bq, 128), min(bkv, 128), r, bw, ce,
+                    cfg.max_seq * (2 if s != cfg.max_seq else 1))
+                   for n, _, bq, bkv, r, bw, ce, s in picked]
+
+    import dataclasses
 
     rng = np.random.default_rng(0)
     results = []
     by_name = {}
     seen_ref = set()  # reference attn ignores blocks: dedupe configs
-    for name, batch, bq, bkv, remat, bwd, ce in configs:
+    for name, batch, bq, bkv, remat, bwd, ce, seq in configs:
+        ccfg = (cfg if seq == cfg.max_seq
+                else dataclasses.replace(cfg, max_seq=seq))
+        cflops_tok = (flops_tok if seq == cfg.max_seq
+                      else M.transformer_flops_per_token(ccfg))
         if attn_name == "reference":
             if bwd == "pallas":
                 print(f"{name:18s} SKIPPED (pallas unavailable)",
                       flush=True)
                 continue
-            key = (batch, remat, ce)
+            key = (batch, remat, ce, seq)
             if key in seen_ref:  # blocks don't matter without pallas —
                 # don't burn multi-minute tunnel compiles on duplicates
                 print(f"{name:18s} SKIPPED (duplicate under reference "
@@ -184,7 +203,7 @@ def main():
             seen_ref.add(key)
         try:
             tokens = jnp.asarray(
-                rng.integers(0, cfg.vocab_size, (batch, cfg.max_seq)),
+                rng.integers(0, ccfg.vocab_size, (batch, ccfg.max_seq)),
                 jnp.int32)
             if attn_name == "flash":
                 attn = functools.partial(
@@ -198,9 +217,9 @@ def main():
                 def body(carry, _):
                     p, o = carry
                     loss, grads = jax.value_and_grad(transformer.loss_fn)(
-                        p, tokens, cfg, attn_fn=attn, remat=remat,
+                        p, tokens, ccfg, attn_fn=attn, remat=remat,
                         ce_impl=("blockwise" if ce == "block" else "dense"),
-                        ce_block=min(2048, cfg.vocab_size))
+                        ce_block=min(2048, ccfg.vocab_size))
                     updates, o = opt.update(grads, o)
                     return (optax.apply_updates(p, updates), o), loss
                 (_, _), losses = lax.scan(
@@ -213,14 +232,14 @@ def main():
             t0 = time.perf_counter()
             float(run(params, opt_state, tokens))
             dt = time.perf_counter() - t0
-            tps = batch * cfg.max_seq * args.steps / dt
-            mfu = tps * flops_tok / peak
-            print(f"{name:18s} tok/s={tps:9.0f}  mfu={mfu:.4f}  "
+            tps = batch * ccfg.max_seq * args.steps / dt
+            mfu = tps * cflops_tok / peak
+            print(f"{name:22s} tok/s={tps:9.0f}  mfu={mfu:.4f}  "
                   f"(compile {compile_s:.0f}s)", flush=True)
             results.append((mfu, name))
             by_name[name] = {"batch": batch, "block_q": bq,
                              "block_kv": bkv, "remat": remat, "bwd": bwd,
-                             "ce": ce, "attn": attn_name}
+                             "ce": ce, "attn": attn_name, "seq": seq}
         except Exception as e:  # noqa: BLE001 - keep sweeping
             print(f"{name:18s} FAILED: {str(e)[:160]}", flush=True)
     for mfu, name in sorted(results, reverse=True):

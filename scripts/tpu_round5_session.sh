@@ -1,0 +1,104 @@
+#!/bin/bash
+# Round-5 chip session — VERDICT r4 order: durable evidence first, then
+# measurement, then decisions.  Probe-gated; each step its own process
+# (serialized claims) under the tunnel watchdog via _session_lib.sh.
+#
+#   1. bench.py -> BENCH_session_r5.json: the durable corrected-
+#      convention line (VERDICT #3) with fed vs_transfer_ceiling
+#      recorded (VERDICT #4) — FIRST minutes of chip contact, before
+#      any sweep can die and take the session with it.
+#   2. Roofline (chained-probe rewrite) -> ROOFLINE.json (VERDICT #6):
+#      the measured HBM/MXU floors that aim the structural ResNet work.
+#   3. fwd/grad step decomposition of the promoted ResNet config
+#      (train - grad = optimizer, grad - fwd = backward).
+#   4. ResNet A/Bs: r4's pending BN-fusion family + round-5 structural
+#      candidates (TFOS_SESSION_RESNET_SWEEP below), promote.
+#   5. Analytic traffic floor vs measured roofline -> TRAFFIC.json.
+#   6. Re-profile the winner -> PERF_BREAKDOWN.md.
+#   7. Transformer: rdots selective-remat subset + long-seq blockwise-CE
+#      configs (VERDICT #8), promote.
+#   8. Final bench.py -> BENCH_session_r5_final.json with whatever got
+#      promoted above.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+log=${TFOS_PERF_LOG:-perf_session_r5.log}
+echo "== r5 session $(date -u +%FT%TZ) ==" | tee -a "$log"
+source scripts/_session_lib.sh
+
+export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/tfos_xla_cache}
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+
+smoke=${TFOS_SESSION_SMOKE:-0}
+profile_extra=""
+roofline_out=ROOFLINE.json
+traffic_out=TRAFFIC.json
+if [ "$smoke" = "1" ]; then
+  export TFOS_SWEEP_SMOKE=1
+  profile_extra="--batch 4"
+  # smoke runs must never clobber the real chip evidence at the repo
+  # root with CPU numbers (resnet_traffic's physics guard only rejects
+  # values ABOVE the ceilings, not a CPU-platform roofline)
+  roofline_out=$(mktemp -u /tmp/tfos_smoke_roofline.XXXX.json)
+  traffic_out=$(mktemp -u /tmp/tfos_smoke_traffic.XXXX.json)
+  echo "(smoke mode: tiny shapes, no promote, benches skipped," \
+       "roofline/traffic -> /tmp)" | tee -a "$log"
+else
+  probe_gate
+fi
+
+rsteps=${TFOS_SESSION_RESNET_STEPS:-20}
+image=${TFOS_SESSION_IMAGE:-224}
+tsteps=${TFOS_SESSION_TRANSFORMER_STEPS:-8}
+
+# -- 1. durable corrected-convention bench line, before anything else --
+if [ "$smoke" = "1" ]; then
+  echo "-- bench.py skipped (smoke mode) --" | tee -a "$log"
+else
+  session_run 7200 bash -c 'python bench.py > BENCH_session_r5.json.tmp \
+    && mv BENCH_session_r5.json.tmp BENCH_session_r5.json \
+    && cat BENCH_session_r5.json'
+fi
+
+# -- 2. measured roofline (fixed script; stale artifact was deleted) --
+session_run 1800 python scripts/roofline.py --out "$roofline_out"
+
+# -- 3. step decomposition of the promoted config ----------------------
+decomp=${TFOS_SESSION_DECOMP:-b256_s2d_bnf}
+TFOS_SWEEP="$decomp" TFOS_SWEEP_MODE=fwd \
+  session_run 3600 python scripts/sweep_resnet.py --steps "$rsteps" --image "$image"
+TFOS_SWEEP="$decomp" TFOS_SWEEP_MODE=grad \
+  session_run 3600 python scripts/sweep_resnet.py --steps "$rsteps" --image "$image"
+
+# -- 4. ResNet A/Bs: pending BN family + structural candidates ---------
+# b256_s2d_bnf re-anchors against r4's 99.2ms; b128/b192 probe the
+# batch-capacity hypothesis; b256_s2d_remat_bnf re-tests remat with the
+# fused-BN backward; b256_s2d closes the bn_relu-fusion A/B
+TFOS_SWEEP="${TFOS_SESSION_RESNET_SWEEP:-b256_s2d_bnf,b128_s2d_bnf,b192_s2d_bnf,b256_s2d_remat_bnf,b256_s2d}" \
+  session_run 7200 python scripts/sweep_resnet.py --steps "$rsteps" --image "$image" --promote
+
+# -- 5. analytic floor against the measured roofline -------------------
+host_run 600 python scripts/resnet_traffic.py --batch 256 \
+    --roofline "$roofline_out" --out "$traffic_out"
+
+# -- 6. where the winner's time goes -----------------------------------
+session_run 3600 python scripts/profile_resnet.py \
+    --out "${TFOS_SESSION_BREAKDOWN:-PERF_BREAKDOWN.md}" \
+    --steps 10 --image "$image" $(python scripts/promoted_profile_args.py) \
+    $profile_extra
+
+# -- 7. transformer: rdots + long-seq blockwise CE ---------------------
+TFOS_SWEEP="${TFOS_SESSION_TRANSFORMER_SWEEP:-b64_q512_kv512_rdots_pbwd,b96_q512_kv512_rdots_pbwd,b96_q512_kv512_remat_pbwd,b16_s4096_remat_pbwd_bce,b16_s4096_remat_pbwd,b32_s4096_remat_pbwd_bce}" \
+  session_run 7200 python scripts/sweep_transformer.py --steps "$tsteps" --promote
+
+# -- 8. final bench with everything promoted ---------------------------
+if [ "$smoke" = "1" ]; then
+  echo "-- final bench.py skipped (smoke mode) --" | tee -a "$log"
+else
+  session_run 7200 bash -c 'python bench.py > BENCH_session_r5_final.json.tmp \
+    && mv BENCH_session_r5_final.json.tmp BENCH_session_r5_final.json \
+    && cat BENCH_session_r5_final.json'
+fi
+
+echo "== done; promoted config: ==" | tee -a "$log"
+cat "${TFOS_BENCH_CONFIG:-bench_config.json}" 2>/dev/null | tee -a "$log" || true
