@@ -10,11 +10,16 @@ counts, per conv, the traffic an *ideally fused* training step must move:
   bwd-filter: read dy, read x, write dw
 
 i.e. 3*(|x|+|y|) activation bytes + 3*|w| weight bytes per conv, in the
-compute dtype.  Dividing by a measured elementwise bandwidth (from
-scripts/roofline.py → ROOFLINE.json) gives a lower-bound step time and
-therefore an upper bound on achievable MFU for this model shape — the
-number `resnet50_train_mfu` should be judged against, alongside the
-datasheet-peak MFU.
+compute dtype, plus the optimizer pass over the f32 master params
+(SGD+momentum: read param/momentum/grad, write param/momentum — 20B per
+parameter, conv + BN + FC head).  Dividing by a measured elementwise
+bandwidth (from scripts/roofline.py → ROOFLINE.json) gives a
+lower-bound step time and therefore an upper bound on achievable MFU
+for this model shape — the number `resnet50_train_mfu` should be
+judged against, alongside the datasheet-peak MFU.  The floors are also
+split fwd/bwd/optimizer to score the on-chip TFOS_SWEEP_MODE=fwd|grad
+decomposition phase by phase.  (Pre-r5 TRAFFIC/PERF history quotes
+33.6 GB for b256 — the same model without the 0.51 GB optimizer pass.)
 
 Usage:
   python scripts/resnet_traffic.py [--batch 256] [--image 224]
@@ -41,40 +46,53 @@ _PLANS = {
 
 
 def conv_cost(n, h_in, w_in, c_in, c_out, k, stride, bytes_per):
-    """Returns (act_bytes, weight_bytes, flops) for one conv in the
-    ideally-fused train step (see module docstring)."""
+    """Per-conv ideally-fused train-step costs, split by phase so the
+    on-chip TFOS_SWEEP_MODE=fwd|grad decomposition (sweep_resnet.py)
+    can be scored against the model phase by phase:
+
+      fwd:  read x + read w, write y           (1x MACs)
+      bwd:  dgrad (read dy, w; write dx) +
+            wgrad (read dy, x; write dw)       (2x MACs)
+
+    Returns (fwd_act, bwd_act, n_weight_elems, fwd_flops, bwd_flops, hw).
+    """
     h_out, w_out = h_in // stride, w_in // stride
     x = n * h_in * w_in * c_in
     y = n * h_out * w_out * c_out
     w = k * k * c_in * c_out
-    act_bytes = 3 * (x + y) * bytes_per
-    weight_bytes = 3 * w * bytes_per
-    # fwd MACs*2; train = fwd + dgrad + wgrad = 3x
-    flops = 3 * 2 * n * h_out * w_out * k * k * c_in * c_out
-    return act_bytes, weight_bytes, flops, (h_out, w_out)
+    fwd_act = (x + y) * bytes_per
+    bwd_act = 2 * (x + y) * bytes_per
+    macs2 = 2 * n * h_out * w_out * k * k * c_in * c_out
+    return fwd_act, bwd_act, w, macs2, 2 * macs2, (h_out, w_out)
 
 
 def resnet_traffic(depth=50, batch=256, image=224, width=64, bytes_per=2,
-                   stem_s2d=True):
+                   stem_s2d=True, num_classes=1000):
     kind, counts = _PLANS[depth]
-    total_act = total_w = total_flops = 0
+    fwd_act = bwd_act = total_w = fwd_flops = bwd_flops = 0
+    n_params = 0  # conv kernels + the 2 BN params following each conv
     n = batch
 
-    def add(r):
-        nonlocal total_act, total_w, total_flops
-        a, w, f, hw = r
-        total_act += a
-        total_w += w
-        total_flops += f
+    def add(r, bn_ch=0):
+        nonlocal fwd_act, bwd_act, total_w, fwd_flops, bwd_flops, n_params
+        fa, ba, w_elems, ff, bf, hw = r
+        fwd_act += fa
+        bwd_act += ba
+        # weight traffic: fwd read + dgrad read + dw write = 3 passes
+        total_w += 3 * w_elems * bytes_per
+        fwd_flops += ff
+        bwd_flops += bf
+        n_params += w_elems + 2 * bn_ch
         return hw
 
     # stem: 7x7/s2 (or the exact-equivalent 4x4/s1 over 2x2 s2d input —
     # same output, slightly different input traffic; use s2d's)
     if stem_s2d:
         hw = add(conv_cost(n, image // 2, image // 2, 12, width, 4, 1,
-                           bytes_per))
+                           bytes_per), bn_ch=width)
     else:
-        hw = add(conv_cost(n, image, image, 3, width, 7, 2, bytes_per))
+        hw = add(conv_cost(n, image, image, 3, width, 7, 2, bytes_per),
+                 bn_ch=width)
     h, w_ = hw[0] // 2, hw[1] // 2  # 3x3/s2 maxpool
     in_ch = width
     for stage, nblocks in enumerate(counts):
@@ -83,19 +101,35 @@ def resnet_traffic(depth=50, batch=256, image=224, width=64, bytes_per=2,
             stride = 2 if (b == 0 and stage > 0) else 1
             if kind == "bottleneck":
                 out_ch = ch * 4
-                add(conv_cost(n, h, w_, in_ch, ch, 1, 1, bytes_per))
-                hw = add(conv_cost(n, h, w_, ch, ch, 3, stride, bytes_per))
-                add(conv_cost(n, hw[0], hw[1], ch, out_ch, 1, 1, bytes_per))
+                add(conv_cost(n, h, w_, in_ch, ch, 1, 1, bytes_per),
+                    bn_ch=ch)
+                hw = add(conv_cost(n, h, w_, ch, ch, 3, stride, bytes_per),
+                         bn_ch=ch)
+                add(conv_cost(n, hw[0], hw[1], ch, out_ch, 1, 1, bytes_per),
+                    bn_ch=out_ch)
             else:
                 out_ch = ch
-                hw = add(conv_cost(n, h, w_, in_ch, ch, 3, stride, bytes_per))
-                add(conv_cost(n, hw[0], hw[1], ch, ch, 3, 1, bytes_per))
+                hw = add(conv_cost(n, h, w_, in_ch, ch, 3, stride, bytes_per),
+                         bn_ch=ch)
+                add(conv_cost(n, hw[0], hw[1], ch, ch, 3, 1, bytes_per),
+                    bn_ch=ch)
             if stride != 1 or in_ch != out_ch:
-                add(conv_cost(n, h, w_, in_ch, out_ch, 1, stride, bytes_per))
+                add(conv_cost(n, h, w_, in_ch, out_ch, 1, stride, bytes_per),
+                    bn_ch=out_ch)
             h, w_ = hw
             in_ch = out_ch
-    return {"act_bytes": total_act, "weight_bytes": total_w,
-            "train_flops": total_flops}
+    # FC head params (w + b) join the conv + BN count
+    n_params += in_ch * num_classes + num_classes
+    # optimizer pass (SGD+momentum over f32 master params): read param,
+    # momentum, grad; write param, momentum — 5 x 4B per parameter.
+    # Small next to activations, but the train-vs-grad decomposition
+    # isolates exactly this, so model it.
+    opt_bytes = 5 * 4 * n_params
+    return {"act_bytes": fwd_act + bwd_act, "weight_bytes": total_w,
+            "train_flops": fwd_flops + bwd_flops,
+            "fwd_act_bytes": fwd_act, "bwd_act_bytes": bwd_act,
+            "fwd_flops": fwd_flops, "bwd_flops": bwd_flops,
+            "opt_bytes": opt_bytes}
 
 
 def main():
@@ -111,7 +145,9 @@ def main():
     args = ap.parse_args()
 
     t = resnet_traffic(args.depth, args.batch, args.image)
-    gb = (t["act_bytes"] + t["weight_bytes"]) / 1e9
+    # whole-step traffic includes the optimizer pass so the headline
+    # floor reconciles with fwd_floor + bwd_floor + opt_floor
+    gb = (t["act_bytes"] + t["weight_bytes"] + t["opt_bytes"]) / 1e9
 
     hbm_gbs = None
     mxu_tflops = args.peak_tflops
@@ -148,6 +184,20 @@ def main():
           f"minimum {gb:.2f} GB/step, {t['train_flops']/1e12:.2f} TFLOP/step")
 
     if hbm_gbs:
+        def phase_floor(act_bytes, flops):
+            """Per-phase lower bound: each phase is bounded by the
+            slower of its own HBM traffic and its own MXU work."""
+            h = act_bytes / 1e9 / hbm_gbs * 1e3
+            m = flops / (mxu_tflops * 1e12) * 1e3
+            return max(h, m), h, m
+
+        # weights traffic: split 1/3 fwd, 2/3 bwd like the act model
+        wb = t["weight_bytes"]
+        fwd_ms, fwd_h, fwd_m = phase_floor(
+            t["fwd_act_bytes"] + wb // 3, t["fwd_flops"])
+        bwd_ms, bwd_h, bwd_m = phase_floor(
+            t["bwd_act_bytes"] + 2 * wb // 3, t["bwd_flops"])
+        opt_ms = t["opt_bytes"] / 1e9 / hbm_gbs * 1e3
         floor_ms = gb / hbm_gbs * 1e3
         mxu_ms = t["train_flops"] / (mxu_tflops * 1e12) * 1e3
         bound_ms = max(floor_ms, mxu_ms)
@@ -159,9 +209,20 @@ def main():
             "mxu_floor_ms": round(mxu_ms, 1),
             "bound": "hbm" if floor_ms > mxu_ms else "mxu",
             "achievable_mfu_ceiling": round(mfu_ceiling, 4),
+            # score these against TFOS_SWEEP_MODE=fwd|grad measurements:
+            # measured fwd vs fwd_floor_ms; (grad - fwd) vs bwd_floor_ms;
+            # (train - grad) vs opt_floor_ms
+            "fwd_floor_ms": round(fwd_ms, 1),
+            "bwd_floor_ms": round(bwd_ms, 1),
+            "opt_floor_ms": round(opt_ms, 2),
+            "fwd_bound": "hbm" if fwd_h > fwd_m else "mxu",
+            "bwd_bound": "hbm" if bwd_h > bwd_m else "mxu",
         })
         print(f"floors: HBM {floor_ms:.1f} ms (at measured {hbm_gbs} GB/s), "
               f"MXU {mxu_ms:.1f} ms (at measured {mxu_tflops} TFLOP/s)")
+        print(f"phase floors: fwd {fwd_ms:.1f} ms "
+              f"({report['fwd_bound']}-bound), bwd {bwd_ms:.1f} ms "
+              f"({report['bwd_bound']}-bound), optimizer {opt_ms:.2f} ms")
         print(f"achievable MFU ceiling (vs {args.peak_tflops} TFLOP/s "
               f"datasheet): {mfu_ceiling:.3f}")
         if args.step_ms:
