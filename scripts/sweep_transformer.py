@@ -59,6 +59,10 @@ CONFIGS = [
     ("b16_s4096_remat_pbwd_bce", 16, 512, 512, True, "pallas", "block", 4096),
     ("b16_s4096_remat_pbwd", 16, 512, 512, True, "pallas", "dense", 4096),
     ("b32_s4096_remat_pbwd_bce", 32, 512, 512, True, "pallas", "block", 4096),
+    # follow-up if the 4096 trio wins: bigger flash blocks amortize the
+    # per-block epilogue over a longer diagonal
+    ("b16_s4096_q1024_kv1024_remat_pbwd_bce",
+     16, 1024, 1024, True, "pallas", "block", 4096),
 ]
 
 
