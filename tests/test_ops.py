@@ -127,31 +127,47 @@ def test_flash_pallas_backward_matches_reference(causal, seq):
             err_msg=f"d{name} mismatch (causal={causal}, seq={seq})")
 
 
-def test_flash_pallas_backward_uneven_blocks():
-    """block_q != block_kv exercises the diagonal bounds in both kernels
-    (dq trims kv at ceil boundaries, dkv starts q at floor boundaries)."""
-    q, k, v = _qkv(jax.random.PRNGKey(3), 1, 128, 1, 8)
-
-    def loss(impl):
-        def f(q, k, v):
-            return jnp.sum(ops.flash_attention(
-                q, k, v, causal=True, block_q=64, block_kv=32,
-                bwd_impl=impl) ** 2)
-        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
-
-    for r, p in zip(loss("xla"), loss("pallas")):
-        np.testing.assert_allclose(np.asarray(p), np.asarray(r), atol=5e-4)
-
-
-def test_flash_pallas_backward_seq2048_sweep_blocks():
-    q, k, v = _qkv(jax.random.PRNGKey(4), 1, 2048, 1, 8)
+def _assert_pallas_bwd_matches_xla(key, seq, block_q, block_kv, atol):
+    """Shared pallas-vs-xla backward parity check: grads of a sum-of-
+    squares loss through flash_attention under both bwd_impls."""
+    q, k, v = _qkv(key, 1, seq, 1, 8)
 
     def grads(impl):
         def f(q, k, v):
             return jnp.sum(ops.flash_attention(
-                q, k, v, causal=True, block_q=512, block_kv=512,
+                q, k, v, causal=True, block_q=block_q, block_kv=block_kv,
                 bwd_impl=impl) ** 2)
         return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
 
-    for r, p in zip(grads("xla"), grads("pallas")):
-        np.testing.assert_allclose(np.asarray(p), np.asarray(r), atol=2e-3)
+    for r, p, name in zip(grads("xla"), grads("pallas"), "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(p), np.asarray(r), atol=atol,
+            err_msg=f"d{name} mismatch (seq={seq}, bq={block_q}, "
+                    f"bkv={block_kv})")
+
+
+def test_flash_pallas_backward_uneven_blocks():
+    """block_q != block_kv exercises the diagonal bounds in both kernels
+    (dq trims kv at ceil boundaries, dkv starts q at floor boundaries)."""
+    _assert_pallas_bwd_matches_xla(jax.random.PRNGKey(3), 128, 64, 32,
+                                   atol=5e-4)
+
+
+def test_flash_pallas_backward_seq2048_sweep_blocks():
+    _assert_pallas_bwd_matches_xla(jax.random.PRNGKey(4), 2048, 512, 512,
+                                   atol=2e-3)
+
+
+@pytest.mark.parametrize("bq,bkv", [(512, 512), (1024, 1024)])
+def test_flash_pallas_backward_seq4096(bq, bkv):
+    """The r5 long-seq sweep configs' regime (s4096 configs in
+    scripts/sweep_transformer.py): fwd + pallas backward parity at
+    seq 4096 with both queued block sizes, interpret mode."""
+    q, k, v = _qkv(jax.random.PRNGKey(5), 1, 4096, 1, 8)
+    ref = ops.mha_reference(q, k, v, causal=True)
+    out = ops.flash_attention(q, k, v, causal=True, block_q=bq,
+                              block_kv=bkv)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5)
+    _assert_pallas_bwd_matches_xla(jax.random.PRNGKey(5), 4096, bq, bkv,
+                                   atol=4e-3)
