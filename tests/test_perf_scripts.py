@@ -78,6 +78,23 @@ def test_transformer_promote_merges_resnet_section(tmp_path):
     assert written["transformer"]["bwd"] == "xla"
 
 
+def test_transformer_promote_records_seq(tmp_path):
+    """The r5 long-seq configs carry a per-config seq; the promote path
+    must record it so bench._transformer_bench sizes cfg.max_seq from
+    the promoted winner (tiny mode rewrites long-seq to 2x the tiny
+    base seq — the key's presence and round-trip is what's under test)."""
+    cfg = tmp_path / "bench_config.json"
+    out = _run(
+        [DRIVER, "sweep_transformer", "faketpu",
+         "--steps", "2", "--promote"],
+        _env(cfg, TFOS_SWEEP="b16_s4096_remat_pbwd_bce"))
+    assert "promoted" in out, out
+    written = json.loads(cfg.read_text())["transformer"]
+    assert written["winner"] == "b16_s4096_remat_pbwd_bce"
+    assert written["seq"] == 512  # tiny base 256 x 2 for long-seq picks
+    assert written["ce"] == "block"
+
+
 def test_promote_refused_on_real_cpu(tmp_path):
     """Without the faked device the promote guard must refuse: a CPU run
     may never pin the TPU bench to toy shapes."""
