@@ -24,6 +24,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from tensorflowonspark_tpu.utils import telemetry  # noqa: E402
+
 
 def run_mode(mode, batch, image, steps):
     import numpy as np
@@ -84,10 +86,18 @@ def main():
     ap.add_argument("--mode", choices=("both", "rows", "columnar"),
                     default="both")
     args = ap.parse_args()
+    if os.environ.get(telemetry.DIR_ENV):
+        # opt-in spans, same schema/dir layout as bench.py and the
+        # cluster nodes (feed/wait comes from DataFeed when enabled)
+        telemetry.configure(node_id="stress-fed", role="stress")
     modes = (["rows", "columnar"] if args.mode == "both" else [args.mode])
     results = []
     for m in modes:
-        r = run_mode(m, args.batch, args.image, args.steps)
+        with telemetry.span(f"stress_fed/{m}", batch=args.batch,
+                            image=args.image, steps=args.steps) as sp:
+            r = run_mode(m, args.batch, args.image, args.steps)
+            if "records_per_sec" in r:
+                sp.add(records_per_sec=r["records_per_sec"])
         print(json.dumps(r), flush=True)
         results.append(r)
     if len(results) == 2 and all("records_per_sec" in r for r in results):
@@ -95,6 +105,7 @@ def main():
         if a:
             print(json.dumps({"columnar_speedup": round(b / a, 2)}),
                   flush=True)
+    telemetry.flush()
 
 
 if __name__ == "__main__":

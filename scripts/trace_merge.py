@@ -1,0 +1,251 @@
+"""Merge per-node telemetry JSONL into one Chrome trace + summary.
+
+Input: a directory of ``utils/telemetry.py`` JSONL files — either a
+drained run directory (``$TFOS_TELEMETRY_DIR/run-<id>/``, written by
+cluster shutdown) or ``$TFOS_TELEMETRY_DIR`` itself (driver files +
+run dirs; scanned recursively).  Output:
+
+  (a) a Chrome ``trace_event`` JSON (``--out``, default
+      ``<dir>/trace.json``) loadable at https://ui.perfetto.dev — one
+      process row per node_id, one thread row per source process;
+  (b) a text summary on stdout: per-phase wall time, per-node step-time
+      percentiles, infeed-stall fraction, and MFU when the ``train/step``
+      spans carry ``flops_per_item``/``peak_flops`` attrs (the counting
+      convention is utils/flops.py's: 2 FLOPs/MAC — TrainMetrics attaches
+      both when constructed with a flops_per_item denominator).
+
+Parity: the reference has no timeline tooling at all — its observability
+is log lines (reference ``__init__.py:1-5``, SURVEY.md §5); this is the
+aggregation half the telemetry tentpole adds on top.
+
+Usage: python scripts/trace_merge.py DIR [--out trace.json]
+           [--summary-out summary.txt]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+SCHEMA_KEYS = ("ts", "node_id", "role", "kind", "name", "dur_ms", "attrs")
+
+_PID_RE = re.compile(r"-(\d+)\.jsonl$")
+
+
+def load_records(run_dir):
+    """((record, source_basename) list sorted by ts, skipped-line count).
+
+    Scans ``run_dir`` recursively for ``*.jsonl`` so both a drained
+    ``run-<id>/`` dir and a whole ``TFOS_TELEMETRY_DIR`` (driver files +
+    run dirs) merge onto one timeline.  Malformed lines are counted, not
+    fatal — a crashed writer's torn tail must not sink the merge.
+    """
+    out = []
+    skipped = 0
+    for root, _dirs, files in os.walk(run_dir):
+        for name in sorted(files):
+            if not name.endswith(".jsonl"):
+                continue
+            path = os.path.join(root, name)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    lines = f.read().splitlines()
+            except OSError:
+                skipped += 1
+                continue
+            for line in lines:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                    if not all(k in rec for k in SCHEMA_KEYS):
+                        raise ValueError("missing schema keys")
+                except (ValueError, TypeError):
+                    skipped += 1
+                    continue
+                out.append((rec, name))
+    out.sort(key=lambda p: p[0]["ts"])
+    return out, skipped
+
+
+def _source_pid(src):
+    m = _PID_RE.search(src)
+    return int(m.group(1)) if m else abs(hash(src)) % 100000
+
+
+def to_chrome_trace(pairs):
+    """Chrome ``trace_event`` dict from (record, source) pairs.
+
+    Mapping: node_id -> trace pid (one process row per node), source
+    file's OS pid -> trace tid (the executor and its forked trainer
+    share a node row but get separate thread lanes, so overlapping spans
+    never fake a nesting).  Spans are ``ph:"X"`` complete events, events
+    are ``ph:"i"`` instants; timestamps are rebased to the earliest
+    record (Perfetto handles epoch offsets, humans don't).
+    """
+    nodes = sorted({rec["node_id"] for rec, _ in pairs})
+    pid_of = {n: i + 1 for i, n in enumerate(nodes)}
+    t0 = min((rec["ts"] for rec, _ in pairs), default=0.0)
+    events = []
+    named_threads = set()
+    for node in nodes:
+        role = next(rec["role"] for rec, _ in pairs if rec["node_id"] == node)
+        events.append({
+            "ph": "M", "name": "process_name", "pid": pid_of[node],
+            "tid": 0, "args": {"name": f"{node} ({role})"},
+        })
+    for rec, src in pairs:
+        pid = pid_of[rec["node_id"]]
+        tid = _source_pid(src)
+        if (pid, tid) not in named_threads:
+            named_threads.add((pid, tid))
+            events.append({
+                "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+                "args": {"name": src[:-len(".jsonl")]},
+            })
+        dur_ms = rec["dur_ms"]
+        base = {
+            "name": rec["name"],
+            "cat": rec["role"],
+            "pid": pid,
+            "tid": tid,
+            "args": rec["attrs"] or {},
+        }
+        if rec["kind"] == "span" and dur_ms is not None:
+            base.update(
+                ph="X",
+                ts=(rec["ts"] - t0) * 1e6,
+                dur=float(dur_ms) * 1e3,
+            )
+        else:
+            base.update(ph="i", ts=(rec["ts"] - t0) * 1e6, s="t")
+        events.append(base)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def _pct(sorted_vals, q):
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def summarize(pairs, skipped=0):
+    """(text, stats) summary: per-phase wall, per-node step percentiles,
+    infeed-stall fraction, MFU (when step spans carry the denominators).
+    """
+    recs = [rec for rec, _ in pairs]
+    phases = {}
+    per_node = {}
+    for rec in recs:
+        node = per_node.setdefault(
+            rec["node_id"],
+            {"role": rec["role"], "steps_ms": [], "items": 0,
+             "model_flops": 0.0, "peak_flops": None, "infeed_s": 0.0},
+        )
+        if rec["kind"] != "span" or rec["dur_ms"] is None:
+            continue
+        ph = phases.setdefault(rec["name"], {"count": 0, "total_ms": 0.0,
+                                             "max_ms": 0.0})
+        ph["count"] += 1
+        ph["total_ms"] += rec["dur_ms"]
+        ph["max_ms"] = max(ph["max_ms"], rec["dur_ms"])
+        attrs = rec["attrs"] or {}
+        if rec["name"] == "train/step":
+            node["steps_ms"].append(float(rec["dur_ms"]))
+            items = attrs.get("items") or 0
+            node["items"] += items
+            if attrs.get("flops_per_item"):
+                node["model_flops"] += items * float(attrs["flops_per_item"])
+            if attrs.get("peak_flops"):
+                node["peak_flops"] = float(attrs["peak_flops"])
+        elif rec["name"] == "feed/wait":
+            node["infeed_s"] += float(rec["dur_ms"]) / 1e3
+
+    stats = {"records": len(recs), "skipped": skipped, "nodes": {},
+             "phases": phases}
+    span = ((max(r["ts"] for r in recs) - min(r["ts"] for r in recs))
+            if recs else 0.0)
+    lines = [
+        f"telemetry summary: {len(per_node)} nodes, {len(recs)} records, "
+        f"{span:.2f}s wall span"
+        + (f", {skipped} unparseable lines skipped" if skipped else "")
+    ]
+
+    lines.append("")
+    lines.append("-- phases (by total wall) --")
+    lines.append(f"{'name':<32} {'count':>7} {'total_ms':>12} {'max_ms':>10}")
+    for name, ph in sorted(phases.items(), key=lambda kv: -kv[1]["total_ms"]):
+        lines.append(f"{name:<32} {ph['count']:>7} {ph['total_ms']:>12.1f} "
+                     f"{ph['max_ms']:>10.1f}")
+
+    lines.append("")
+    lines.append("-- per-node train steps --")
+    lines.append(
+        f"{'node':<16} {'role':<10} {'steps':>6} {'p50_ms':>8} {'p90_ms':>8} "
+        f"{'p99_ms':>8} {'total_s':>8} {'infeed_s':>9} {'stall':>6} "
+        f"{'mfu':>6}")
+    for name in sorted(per_node):
+        n = per_node[name]
+        steps = sorted(n["steps_ms"])
+        total_s = sum(steps) / 1e3
+        # fraction of the train loop spent waiting on the feed: bounded
+        # to [0, 1) even when waits dwarf compute (feeder-starved runs)
+        loop_s = total_s + n["infeed_s"]
+        stall = n["infeed_s"] / loop_s if loop_s else 0.0
+        mfu = (n["model_flops"] / total_s / n["peak_flops"]
+               if total_s and n["model_flops"] and n["peak_flops"] else None)
+        stats["nodes"][name] = {
+            "role": n["role"], "steps": len(steps),
+            "p50_ms": _pct(steps, 0.50), "p90_ms": _pct(steps, 0.90),
+            "p99_ms": _pct(steps, 0.99), "step_total_s": total_s,
+            "infeed_wait_s": n["infeed_s"], "infeed_stall_frac": stall,
+            "mfu": mfu, "items": n["items"],
+        }
+        s = stats["nodes"][name]
+        lines.append(
+            f"{name:<16} {n['role']:<10} {s['steps']:>6} {s['p50_ms']:>8.1f} "
+            f"{s['p90_ms']:>8.1f} {s['p99_ms']:>8.1f} {total_s:>8.2f} "
+            f"{n['infeed_s']:>9.3f} {stall:>6.2f} "
+            f"{(f'{mfu:.3f}' if mfu is not None else '-'):>6}")
+    return "\n".join(lines) + "\n", stats
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("run_dir", help="telemetry dir (run-<id>/ or the root)")
+    ap.add_argument("--out", default=None,
+                    help="Chrome trace path (default <run_dir>/trace.json)")
+    ap.add_argument("--summary-out", default=None,
+                    help="also write the text summary to this path")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.run_dir):
+        ap.error(f"not a directory: {args.run_dir}")
+    pairs, skipped = load_records(args.run_dir)
+    if not pairs:
+        print(f"trace_merge: no telemetry records under {args.run_dir}",
+              file=sys.stderr)
+        return 1
+
+    out = args.out or os.path.join(args.run_dir, "trace.json")
+    trace = to_chrome_trace(pairs)
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(trace, f)
+    text, _stats = summarize(pairs, skipped)
+    if args.summary_out:
+        with open(args.summary_out, "w", encoding="utf-8") as f:
+            f.write(text)
+    sys.stdout.write(text)
+    print(f"\nchrome trace: {out} ({len(trace['traceEvents'])} events) — "
+          f"load at https://ui.perfetto.dev")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -28,6 +28,7 @@ import time
 from tensorflowonspark_tpu import engine as engine_mod
 from tensorflowonspark_tpu import manager as tfmanager
 from tensorflowonspark_tpu import node, rendezvous
+from tensorflowonspark_tpu.utils import telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -133,48 +134,103 @@ class TFCluster:
         watchdog = threading.Timer(timeout, _watchdog)
         watchdog.daemon = True
         watchdog.start()
+
+        drained = []
+
+        def _drain_once():
+            # exactly one drain per shutdown, clean OR error path — the
+            # error timeline is the one most worth collecting
+            if drained:
+                return
+            drained.append(True)
+            try:
+                self._drain_telemetry()
+            except Exception as e:  # noqa: BLE001 - drain is best-effort
+                logger.warning("telemetry drain failed: %s", e)
+
         try:
-            # Spark Streaming: wait for the StreamingContext to terminate,
-            # stopping it ourselves once a consumer's STOP reaches the
-            # rendezvous server (parity: TFCluster.py:146-153)
-            if ssc is not None:
-                while not ssc.awaitTerminationOrTimeout(1):
-                    if self.server.done.is_set():
-                        logger.info("server done, stopping StreamingContext")
-                        ssc.stop(stopSparkContext=False, stopGraceFully=True)
-                        break
-            # signal end-of-feed on every worker's queues
-            worker_ids = sorted(m["executor_id"] for m in workers)
-            if worker_ids:
-                shutdown_ds = self.engine.parallelize(worker_ids, len(worker_ids))
-                shutdown_ds.foreach_partition(
-                    node.shutdown(
-                        self.cluster_info, self.queues, self.meta["id"], grace_secs
-                    ),
-                    placement=worker_ids,
-                )
+            with telemetry.span("cluster/shutdown", grace_secs=grace_secs):
+                try:
+                    # Spark Streaming: wait for the StreamingContext to
+                    # terminate, stopping it ourselves once a consumer's
+                    # STOP reaches the rendezvous server
+                    # (parity: TFCluster.py:146-153)
+                    if ssc is not None:
+                        while not ssc.awaitTerminationOrTimeout(1):
+                            if self.server.done.is_set():
+                                logger.info(
+                                    "server done, stopping StreamingContext")
+                                ssc.stop(stopSparkContext=False,
+                                         stopGraceFully=True)
+                                break
+                    # signal end-of-feed on every worker's queues
+                    worker_ids = sorted(m["executor_id"] for m in workers)
+                    if worker_ids:
+                        shutdown_ds = self.engine.parallelize(
+                            worker_ids, len(worker_ids))
+                        shutdown_ds.foreach_partition(
+                            node.shutdown(
+                                self.cluster_info, self.queues,
+                                self.meta["id"], grace_secs
+                            ),
+                            placement=worker_ids,
+                        )
 
-            # drive ps/evaluator to stop via their remote managers
-            # (TFCluster.py:186-194).  This MUST precede joining the
-            # launcher: ps/evaluator node tasks hold their engine slots
-            # until the control message arrives, so the launcher job
-            # cannot complete before they are told to stop.
-            for m in ps_eval:
-                _stop_remote_node(m)
+                    # drive ps/evaluator to stop via their remote managers
+                    # (TFCluster.py:186-194).  This MUST precede joining the
+                    # launcher: ps/evaluator node tasks hold their engine
+                    # slots until the control message arrives, so the
+                    # launcher job cannot complete before they are told to
+                    # stop.
+                    for m in ps_eval:
+                        _stop_remote_node(m)
 
-            # wait for the node-launcher thread (all nodes now run to
-            # completion)
-            if self._launcher is not None:
-                self._launcher.join(timeout=timeout)
+                    # wait for the node-launcher thread (all nodes now run
+                    # to completion)
+                    if self._launcher is not None:
+                        self._launcher.join(timeout=timeout)
+                except BaseException:
+                    _drain_once()  # a failed worker's timeline still drains
+                    raise
 
-            if tf_status.get("error"):
-                logger.error("cluster failed: %s", tf_status["error"])
-                self.engine.cancel_all_jobs()
-                sys.exit(1)
+                _drain_once()
+                if tf_status.get("error"):
+                    logger.error("cluster failed: %s", tf_status["error"])
+                    telemetry.event(
+                        "cluster/error", error=str(tf_status["error"])[:500])
+                    self.engine.cancel_all_jobs()
+                    sys.exit(1)
         finally:
             watchdog.cancel()
             self.server.stop()
+            telemetry.flush()
         logger.info("cluster shut down")
+
+    def _drain_telemetry(self):
+        """Collect every node's spooled telemetry JSONL into one run
+        directory, ``$TFOS_TELEMETRY_DIR/run-<cluster id>/`` — the driver
+        half of the drain (executor half: node.drain_telemetry; transport:
+        the manager KV registry, manager.py).  No-op when telemetry is
+        disabled."""
+        rdir = telemetry.run_dir(self.meta["id"])
+        if rdir is None:
+            return None
+        n = self.meta["num_executors"]
+        with telemetry.span("cluster/telemetry_drain", executors=n) as sp:
+            ds = self.engine.parallelize(list(range(n)), n)
+            rows = ds.map_partitions(
+                node.drain_telemetry(self.cluster_info)
+            ).collect(spread=True)
+            os.makedirs(rdir, exist_ok=True)
+            files = 0
+            for executor_id, name, text in rows:
+                dest = os.path.join(rdir, f"exec{executor_id}-{name}")
+                with open(dest, "a", encoding="utf-8") as f:
+                    f.write(text)
+                files += 1
+            sp.add(files=files)
+        logger.info("telemetry: drained %d node files into %s", files, rdir)
+        return rdir
 
     def tensorboard_url(self):
         """URL of the dashboard node, if one was launched
@@ -239,6 +295,12 @@ def run(
     ``LocalEngine``.  ``num_chips`` replaces the implicit GPU count.
     """
     logger.info("Reserving TFSparkNodes-TPU")
+    start_t0 = time.perf_counter()
+    if os.environ.get(telemetry.DIR_ENV):
+        # Pin the driver identity BEFORE any node closure can run in-process
+        # (sparkstub / driver_ps_nodes): node_configure skips relabelling
+        # when it sees role=driver.
+        telemetry.configure(node_id="driver", role="driver")
     eng = engine_mod.as_engine(sc)
     queues = list(queues)
 
@@ -339,6 +401,10 @@ def run(
         (m["job_name"], m["task_index"], m["host"], m["executor_id"])
         for m in cluster_info
     ])
+    telemetry.record_span(
+        "cluster/start", time.perf_counter() - start_t0,
+        cluster=f"{cluster_meta['id'] & 0xffffffff:x}",
+        executors=num_executors, nodes=len(cluster_info))
 
     c = TFCluster()
     c.sc = sc

@@ -47,6 +47,8 @@ import multiprocessing as mp
 
 import cloudpickle
 
+from tensorflowonspark_tpu.utils import telemetry
+
 logger = logging.getLogger(__name__)
 
 
@@ -100,6 +102,10 @@ def _executor_main(index, workdir, shared_inbox, own_inbox, results):
     """Executor process loop: pull a task, run it, report the result."""
     os.chdir(workdir)
     os.environ["TFOS_EXECUTOR_INDEX"] = str(index)
+    # Executors are never the driver: shed any inherited driver telemetry
+    # identity so a node task can label this process for its cluster.
+    os.environ.pop(telemetry.NODE_ENV, None)
+    os.environ[telemetry.ROLE_ENV] = "executor"
     try:
         while True:
             msg = None
@@ -115,13 +121,16 @@ def _executor_main(index, workdir, shared_inbox, own_inbox, results):
                 break
             _, job_id, task_id, blob = msg
             try:
-                fn, items, collect = cloudpickle.loads(blob)
-                out = fn(iter(items))
-                result = list(out) if (collect and out is not None) else None
+                with telemetry.span("engine/task", job=job_id, task=task_id):
+                    fn, items, collect = cloudpickle.loads(blob)
+                    out = fn(iter(items))
+                    result = (list(out) if (collect and out is not None)
+                              else None)
                 results.put(("ok", job_id, task_id, index, result))
             except BaseException:  # noqa: BLE001 - must report any task failure
                 results.put(("error", job_id, task_id, index, traceback.format_exc()))
     finally:
+        telemetry.flush()
         _reap_executor_children()
 
 
@@ -381,6 +390,13 @@ class LocalEngine:
             job_id = self._job_counter
             my_results = _queue.Queue()
             self._job_queues[job_id] = my_results
+        with telemetry.span("engine/job", job=job_id, tasks=len(tasks),
+                            spread=bool(spread or placement is not None)):
+            return self._run_job_inner(
+                tasks, collect, spread, placement, job_id, my_results)
+
+    def _run_job_inner(self, tasks, collect, spread, placement, job_id,
+                       my_results):
         # Only executors that die DURING this job abort it; one already lost
         # to an earlier job must not fail work the survivors can finish.
         dead_at_start = {i for i, p in enumerate(self._procs) if not p.is_alive()}

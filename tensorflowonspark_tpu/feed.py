@@ -19,6 +19,7 @@ import threading
 import time
 
 from tensorflowonspark_tpu import marker
+from tensorflowonspark_tpu.utils import telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -156,7 +157,8 @@ class DataFeed:
         local check), 1s on the manager-queue compat path where every
         attempt is a proxy RPC — the stop flag only needs sub-second
         responsiveness, not a 10Hz round-trip load on the manager."""
-        t0 = time.perf_counter() if self.metrics is not None else None
+        timed = self.metrics is not None or telemetry.enabled()
+        t0 = time.perf_counter() if timed else None
         slice_ms = 100 if self._ring is not None else 1000
         while True:
             if self._stop_requested:
@@ -168,7 +170,22 @@ class DataFeed:
             except TimeoutError:
                 continue
         if t0 is not None:
-            self.metrics.infeed_wait(time.perf_counter() - t0)
+            # ONE measurement feeds both layers (TrainMetrics.infeed_wait
+            # and the telemetry span), so the stall fractions they report
+            # agree by construction.
+            dt = time.perf_counter() - t0
+            if self.metrics is not None:
+                self.metrics.infeed_wait(dt)
+            if telemetry.enabled():
+                attrs = {"eof": chunk is None}
+                try:
+                    if self._ring is not None:
+                        attrs["queue_bytes"] = self._ring.qsize_bytes()
+                    elif self._queue is not None:
+                        attrs["queue_chunks"] = self._queue.qsize()
+                except Exception:  # noqa: BLE001 - depth is best-effort
+                    pass
+                telemetry.record_span("feed/wait", dt, **attrs)
         return chunk
 
     def next_batch(self, batch_size):

@@ -54,6 +54,20 @@ class TFManager(BaseManager):
     def set(self, key, value):
         self.kv().update({key: value})
 
+    # -- telemetry drain channel (utils/telemetry.py) ------------------
+    # Every process on this executor advertises its spool dir under a
+    # path-unique KV key (no read-modify-write race across the trainer,
+    # feeder and node processes); the driver-side shutdown drain asks
+    # for the set and collects the JSONL files (node.drain_telemetry).
+
+    def telemetry_register(self, path):
+        self.kv().update({"telemetry_spool:" + str(path): str(path)})
+
+    def telemetry_spools(self):
+        prefix = "telemetry_spool:"
+        return sorted(v for k, v in self.kv().items()
+                      if str(k).startswith(prefix))
+
 
 # Server-side singletons (one manager process per executor).  Queues are
 # created lazily *inside the manager server process* on first access: under

@@ -344,7 +344,13 @@ def zigzag_lm_batch(tokens, perm):
     ``positions`` the global rope positions — feed to ``loss_fn(...,
     labels=labels_p, positions=positions)`` with a zigzag ``attn_fn``.
     """
-    labels = jnp.concatenate(
-        [tokens[:, 1:], jnp.full((tokens.shape[0], 1), -1, tokens.dtype)],
-        axis=1)
+    # roll + where, NOT concatenate(tokens[:, 1:], -1): under the SPMD
+    # partitioner (seq-sharded tokens, jitted) the slice+concat lowering
+    # summed the two seq shards' contributions — every label came back
+    # exactly doubled, overran the vocab, and take_along_axis's
+    # out-of-bounds fill turned the loss into NaN.  roll keeps the shift
+    # a collective-permute, which partitions correctly.
+    s = tokens.shape[1]
+    labels = jnp.where(jnp.arange(s) == s - 1, jnp.array(-1, tokens.dtype),
+                       jnp.roll(tokens, -1, axis=1))
     return tokens[:, perm], labels[:, perm], jnp.asarray(perm, jnp.int32)

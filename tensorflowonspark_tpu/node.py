@@ -38,6 +38,7 @@ from tensorflowonspark_tpu.utils import (
     get_ip_address,
     read_executor_id,
     reap_child,
+    telemetry,
     track_child_pid,
     write_executor_id,
 )
@@ -158,6 +159,20 @@ class TFNodeContext:
         if env["num_processes"] > 1:
             import jax
 
+            plat = (os.environ.get("JAX_PLATFORMS")
+                    or str(getattr(jax.config, "jax_platforms", None) or ""))
+            if plat.split(",")[0].strip() == "cpu":
+                # multi-process SPMD on the CPU backend needs the gloo
+                # cross-process collectives; without them every sharded
+                # computation fails with "Multiprocess computations
+                # aren't implemented on the CPU backend".  Must be set
+                # before the backend initializes.
+                try:
+                    jax.config.update(
+                        "jax_cpu_collectives_implementation", "gloo")
+                except Exception:  # noqa: BLE001 - option may move/vanish
+                    logger.warning("could not enable gloo cpu collectives",
+                                   exc_info=True)
             jax.distributed.initialize(
                 coordinator_address=env["coordinator_address"],
                 num_processes=env["num_processes"],
@@ -254,6 +269,7 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
     queues = queues or ["input", "output", "error", "control"]
 
     def _mapfn(iterator):
+        boot_t0 = time.perf_counter()
         executor_id = None
         for item in iterator:  # one element per spread partition
             executor_id = item
@@ -268,6 +284,17 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
         job_name, task_index = _job_for_executor(
             cluster_meta["cluster_template"], executor_id
         )
+
+        # Pin telemetry identity + node-local spool for this process AND
+        # its fork children (trainer), via the env channel.  In-process
+        # engines (sparkstub) may run this in the driver itself — never
+        # relabel the driver's recorder there.
+        if os.environ.get(telemetry.ROLE_ENV) != "driver":
+            telemetry.configure(
+                node_id=f"{job_name}-{task_index}",
+                role=job_name,
+                spool=os.path.abspath(".tfos_telemetry"),
+            )
 
         # (3) idempotency/retry guard (TFSparkNode.py:249-255): a live
         # manager from the SAME cluster means a duplicate placement — raise
@@ -348,6 +375,8 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
                 # pid in the manager KV so the shutdown closure (which may
                 # run in a different python worker) can kill the child
                 mgr.set("tb_pid", _NodeState.tb_proc.pid)
+                telemetry.event("node/tb_spawn", port=tb_port,
+                                pid=_NodeState.tb_proc.pid)
 
         client.register(node_meta)
         cluster_info = client.await_reservations(
@@ -373,14 +402,31 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
         # release the reserved port as late as possible
         tmp_sock.close()
 
+        # Boot complete: chips claimed, manager up, rendezvous done.  The
+        # spool dir is advertised in the manager KV so the driver drain
+        # (cluster.shutdown -> drain_telemetry) can find every node file.
+        telemetry.register_with(mgr)
+        telemetry.record_span(
+            "node/boot", time.perf_counter() - boot_t0,
+            executor=executor_id, nodes=len(cluster_info))
+
         def wrapper_fn(args, context):
             if isinstance(args, list):
                 sys.argv = args
-            fn(args, context)
-            # all processes leave together (see sync_exit_barrier docstring)
-            context.sync_exit_barrier()
+            try:
+                with telemetry.span("node/main", job=context.job_name,
+                                    task=context.task_index):
+                    fn(args, context)
+                # all processes leave together (see sync_exit_barrier
+                # docstring)
+                context.sync_exit_barrier()
+            finally:
+                telemetry.flush()
 
         def wrapper_fn_background(args, context):
+            # fork child: the pid-keyed recorder opens its own sink file;
+            # advertise it for the shutdown drain
+            telemetry.register_with(mgr)
             errq = mgr.get_queue("error")
             try:
                 wrapper_fn(args, context)
@@ -557,6 +603,7 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
 
     def _train(iterator):
         mgr = _get_manager(cluster_info, get_ip_address(), read_executor_id())
+        telemetry.register_with(mgr)
         state = str(mgr.get("state"))
         if state in ("terminating", "stopped"):
             logger.info("feeder: state=%s, skipping/draining partition", state)
@@ -613,6 +660,9 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
                         discarded + len(chunk))
         logger.info("feeder: queued %d records (%s path)", total,
                     "shm" if ring is not None else "manager")
+        telemetry.event("feed/partition_queued", records=total,
+                        path="shm" if ring is not None else "manager",
+                        terminated=terminated)
 
         if ring is not None:
             if not terminated:
@@ -641,6 +691,7 @@ def inference(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
 
     def _inference(iterator):
         mgr = _get_manager(cluster_info, get_ip_address(), read_executor_id())
+        telemetry.register_with(mgr)
         ring = _open_feed_ring(mgr, qname)
         queue = None if ring is not None else mgr.get_queue(qname)
         encode = _make_chunk_encoder()
@@ -759,3 +810,29 @@ def shutdown(cluster_info, queues, cluster_id, grace_secs=0):
         mgr.set("state", "stopped")
 
     return _shutdown
+
+
+def drain_telemetry(cluster_info):
+    """Executor-side telemetry drain closure: flush this process, then
+    read every spool dir the node's processes advertised in the manager
+    KV (telemetry.register_with) and return the raw JSONL so the driver
+    can write one run directory.  Best-effort throughout — a drain
+    failure must never turn a clean shutdown into an error."""
+
+    def _drain(iterator):
+        list(iterator)
+        telemetry.flush()
+        out = []
+        try:
+            executor_id = read_executor_id()
+            mgr = _get_manager(cluster_info, get_ip_address(), executor_id)
+            spools = mgr.telemetry_spools()
+        except Exception as e:  # noqa: BLE001 - drain is best-effort
+            logger.warning("telemetry drain: no manager/spools: %s", e)
+            return out
+        for spool in spools:
+            for name, text in telemetry.read_spool(spool):
+                out.append((executor_id, name, text))
+        return out
+
+    return _drain

@@ -29,6 +29,8 @@ import struct
 import threading
 import time
 
+from tensorflowonspark_tpu.utils import telemetry
+
 logger = logging.getLogger(__name__)
 
 TFOS_SERVER_HOST = "TFOS_SERVER_HOST"
@@ -219,17 +221,23 @@ class Server(MessageSocket):
         ``status`` is the shared driver-side dict; an 'error' key set by the
         launcher thread aborts the wait (parity: TFCluster.py tf_status).
         """
-        deadline = time.time() + timeout
-        while not self.reservations.done():
-            if status and status.get("error"):
-                raise RuntimeError(f"node startup failed: {status['error']}")
-            if time.time() > deadline:
-                raise TimeoutError(
-                    f"timed out waiting for {self.reservations.remaining()} "
-                    f"of {self.reservations.required} reservations"
-                )
-            time.sleep(0.1)
-        return self.reservations.get()
+        with telemetry.span("rendezvous/await_reservations",
+                            required=self.reservations.required) as sp:
+            deadline = time.time() + timeout
+            while not self.reservations.done():
+                if status and status.get("error"):
+                    raise RuntimeError(
+                        f"node startup failed: {status['error']}")
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"timed out waiting for "
+                        f"{self.reservations.remaining()} "
+                        f"of {self.reservations.required} reservations"
+                    )
+                time.sleep(0.1)
+            got = self.reservations.get()
+            sp.add(registered=len(got))
+            return got
 
     def stop(self):
         self.done.set()
@@ -265,19 +273,29 @@ class Client(MessageSocket):
         return reply
 
     def register(self, node_meta):
-        return self._call({"type": "REG", "data": node_meta})
+        with telemetry.span(
+                "rendezvous/register",
+                job=node_meta.get("job_name") if isinstance(node_meta, dict)
+                else None,
+                task=node_meta.get("task_index") if isinstance(node_meta, dict)
+                else None):
+            return self._call({"type": "REG", "data": node_meta})
 
     def get_reservations(self):
         return self._call({"type": "QINFO"})["data"]
 
     def await_reservations(self, timeout=DEFAULT_TIMEOUT):
         """Poll until the cluster is complete, then return all node metas."""
-        deadline = time.time() + timeout
-        while not self._call({"type": "QUERY"})["data"]:
-            if time.time() > deadline:
-                raise TimeoutError("timed out awaiting cluster completion")
-            time.sleep(POLL_SECS)
-        return self.get_reservations()
+        with telemetry.span("rendezvous/await_cluster_spec") as sp:
+            deadline = time.time() + timeout
+            polls = 0
+            while not self._call({"type": "QUERY"})["data"]:
+                polls += 1
+                if time.time() > deadline:
+                    raise TimeoutError("timed out awaiting cluster completion")
+                time.sleep(POLL_SECS)
+            sp.add(polls=polls)
+            return self.get_reservations()
 
     def request_stop(self):
         try:

@@ -22,6 +22,7 @@ import os
 import numpy as np
 
 from tensorflowonspark_tpu.recordio import fs as _fs
+from tensorflowonspark_tpu.utils import telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -50,29 +51,30 @@ def _unflatten(flat):
 def save_checkpoint(ckpt_dir, params, step, keep=3):
     """Write step-stamped npz checkpoint to any filesystem (local,
     gs://, hdfs://, ... via fsspec); prune old ones."""
-    _fs.makedirs(ckpt_dir)
-    flat = _flatten(_to_host(params))
-    path = _fs.join(ckpt_dir, f"ckpt-{step:08d}.npz")
-    if _fs.is_local(ckpt_dir):
-        lp = _fs.local_path(path)
-        # pid-unique tmp: concurrent writers (several workers sharing one
-        # filesystem) must not clobber each other's in-flight file
-        tmp = f"{lp}.{os.getpid()}.tmp"
-        with open(tmp, "wb") as f:
-            np.savez(f, **flat)
-        os.replace(tmp, lp)  # atomic publish
-    else:
-        buf = io.BytesIO()  # object stores publish atomically on PUT
-        np.savez(buf, **flat)
-        _fs.write_bytes(path, buf.getvalue())
-    logger.info("saved checkpoint %s", path)
-    ckpts = sorted(
-        p for p in _fs.listdir(ckpt_dir)
-        if p.startswith("ckpt-") and p.endswith(".npz")
-    )
-    for old in ckpts[:-keep]:
-        _fs.remove(_fs.join(ckpt_dir, old))
-    return path
+    with telemetry.span("checkpoint/save", step=step):
+        _fs.makedirs(ckpt_dir)
+        flat = _flatten(_to_host(params))
+        path = _fs.join(ckpt_dir, f"ckpt-{step:08d}.npz")
+        if _fs.is_local(ckpt_dir):
+            lp = _fs.local_path(path)
+            # pid-unique tmp: concurrent writers (several workers sharing
+            # one filesystem) must not clobber each other's in-flight file
+            tmp = f"{lp}.{os.getpid()}.tmp"
+            with open(tmp, "wb") as f:
+                np.savez(f, **flat)
+            os.replace(tmp, lp)  # atomic publish
+        else:
+            buf = io.BytesIO()  # object stores publish atomically on PUT
+            np.savez(buf, **flat)
+            _fs.write_bytes(path, buf.getvalue())
+        logger.info("saved checkpoint %s", path)
+        ckpts = sorted(
+            p for p in _fs.listdir(ckpt_dir)
+            if p.startswith("ckpt-") and p.endswith(".npz")
+        )
+        for old in ckpts[:-keep]:
+            _fs.remove(_fs.join(ckpt_dir, old))
+        return path
 
 
 def latest_checkpoint(ckpt_dir):
@@ -86,8 +88,9 @@ def latest_checkpoint(ckpt_dir):
 
 
 def load_checkpoint(path):
-    with _fs.open_file(path, "rb") as f, np.load(f) as z:
-        return _unflatten({k: z[k] for k in z.files})
+    with telemetry.span("checkpoint/restore", path=os.path.basename(path)):
+        with _fs.open_file(path, "rb") as f, np.load(f) as z:
+            return _unflatten({k: z[k] for k in z.files})
 
 
 def export_model(export_dir, params, ctx=None, metadata=None):
@@ -97,17 +100,18 @@ def export_model(export_dir, params, ctx=None, metadata=None):
         logger.info("export_model: not chief (%s:%s), skipping",
                     ctx.job_name, ctx.task_index)
         return None
-    _fs.makedirs(export_dir)
-    flat = _flatten(_to_host(params))
-    buf = io.BytesIO()
-    np.savez(buf, **flat)
-    _fs.write_bytes(_fs.join(export_dir, "params.npz"), buf.getvalue())
-    meta = {"format": "tfos-tpu-export-v1"}
-    meta.update(metadata or {})
-    _fs.write_bytes(_fs.join(export_dir, "export.json"),
-                    json.dumps(meta).encode())
-    logger.info("exported model to %s", export_dir)
-    return export_dir
+    with telemetry.span("checkpoint/export"):
+        _fs.makedirs(export_dir)
+        flat = _flatten(_to_host(params))
+        buf = io.BytesIO()
+        np.savez(buf, **flat)
+        _fs.write_bytes(_fs.join(export_dir, "params.npz"), buf.getvalue())
+        meta = {"format": "tfos-tpu-export-v1"}
+        meta.update(metadata or {})
+        _fs.write_bytes(_fs.join(export_dir, "export.json"),
+                        json.dumps(meta).encode())
+        logger.info("exported model to %s", export_dir)
+        return export_dir
 
 
 def load_exported(export_dir):
@@ -209,6 +213,13 @@ class AsyncCheckpointer:
 
     def save(self, step, tree):
         """Queue an async save of ``tree`` at ``step`` (non-blocking)."""
+        import jax
+
+        # orbax's StandardSave rejects numpy scalar leaves (np.float32);
+        # promote them to 0-d arrays, which round-trip identically
+        tree = jax.tree.map(
+            lambda x: np.asarray(x) if isinstance(x, np.generic) else x,
+            tree)
         self._mngr.save(step, args=self._ocp.args.StandardSave(tree))
 
     def latest_step(self):
@@ -219,7 +230,10 @@ class AsyncCheckpointer:
         step = self._mngr.latest_step()
         if step is None:
             return None, 0
-        return self._mngr.restore(step), step
+        # explicit StandardRestore: a fresh manager over an existing dir
+        # has no registered handler yet and raises KeyError without it
+        return self._mngr.restore(
+            step, args=self._ocp.args.StandardRestore()), step
 
     def wait(self):
         self._mngr.wait_until_finished()

@@ -59,7 +59,11 @@ def _conv_macs(eqn):
     out = eqn.outvars[0].aval.shape
     taps = math.prod(rhs[i] for i in dn.rhs_spec[2:])
     in_ch = lhs[dn.lhs_spec[1]]
-    groups = p.get("feature_group_count", 1) * p.get("batch_group_count", 1)
+    # only feature groups shrink the per-output contraction (each output
+    # channel sees in_ch/feature_groups inputs).  batch groups shrink the
+    # OUTPUT batch dim instead — already reflected in prod(out) — so
+    # dividing by batch_group_count double-counted the reduction.
+    groups = p.get("feature_group_count", 1)
     dil = math.prod(p.get("lhs_dilation") or (1,))
     return math.prod(out) * taps * in_ch // groups // dil
 

@@ -20,6 +20,8 @@ import logging
 import os
 import time
 
+from tensorflowonspark_tpu.utils import telemetry
+
 logger = logging.getLogger(__name__)
 
 # bf16 peak FLOP/s per chip by device-kind substring (same table as bench.py)
@@ -134,8 +136,18 @@ class TrainMetrics:
         rates divide N timed steps' items by N timed steps' time."""
         now = time.perf_counter()
         if self._last is not None:
-            self.step_time += now - self._last
+            dur = now - self._last
+            self.step_time += dur
             self.items += items
+            if telemetry.enabled():
+                # same measured duration as the counter above, so the
+                # trace-merge percentiles and report() agree exactly
+                attrs = {"items": items}
+                if self.flops_per_item:
+                    attrs["flops_per_item"] = self.flops_per_item
+                if self._peak:
+                    attrs["peak_flops"] = self._peak
+                telemetry.record_span("train/step", dur, **attrs)
         self._last = now
         self.steps += 1
 

@@ -1,0 +1,326 @@
+"""Cluster-wide structured telemetry: per-node event spans on one schema.
+
+Parity target: the reference's observability is *log lines only* —
+``logging.basicConfig`` at import (reference ``__init__.py:1-5``) plus
+free-text records for cluster_info (``TFCluster.py:343-344``), node
+registrations (``TFSparkNode.py:356``) and feed counts
+(``TFSparkNode.py:497``); no metrics, no counters, no timeline
+(SURVEY.md §5).  This module replaces those log lines with structured
+spans so a whole federated run (reservation → rendezvous → compile →
+steps → shutdown) lands on ONE timeline that
+``scripts/trace_merge.py`` renders as a Perfetto-loadable Chrome trace
+and a stall-attribution summary.
+
+Design constraints (all load-bearing):
+
+- **Zero-dep / stdlib-only** — imported by engine executors, feeder
+  tasks, forked trainers and the driver; must never pull jax/numpy.
+- **Opt-in via env** — enabled iff ``TFOS_TELEMETRY_DIR`` is set; when
+  unset every call is a cached no-op (no files, no measurable cost).
+- **Monotonic durations** — ``dur_ms`` comes from ``perf_counter``
+  deltas; ``ts`` is wall-clock (``time.time``) only to *anchor* spans
+  on a shared timeline across processes of one host/run.
+- **Bounded ring buffer** — records buffer in a ``deque(maxlen=...)``
+  between flushes, so an unwritable sink degrades to dropped telemetry
+  (counted), never to unbounded memory or a crashed trainer.
+- **Safe under spawn/fork** — the recorder is keyed by pid: a fork or
+  spawn child lazily opens its OWN ``<node>-<pid>.jsonl`` sink, and a
+  ``multiprocessing.util.Finalize`` hook (multiprocessing children skip
+  ``atexit``) flushes it at child exit.
+
+One record per line (JSONL), one schema everywhere::
+
+    {"ts": <epoch s>, "node_id": "worker-0", "role": "worker",
+     "kind": "span"|"event", "name": "train/step",
+     "dur_ms": <float>|null, "attrs": {...}}
+
+Env vars:
+  ``TFOS_TELEMETRY_DIR``    master switch + driver-side sink/run dir.
+  ``TFOS_TELEMETRY_SPOOL``  node-local spool dir override (node.py sets
+                            it per executor; the driver drain collects
+                            spools into ``<dir>/run-<id>/``).
+  ``TFOS_TELEMETRY_NODE``/``TFOS_TELEMETRY_ROLE``  identity defaults,
+                            inherited by forked/spawned children.
+  ``TFOS_TELEMETRY_BUFFER`` ring capacity (default 4096 records).
+  ``TFOS_TELEMETRY_FLUSH``  flush threshold (default 128 records).
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import json
+import logging
+import os
+import socket
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+DIR_ENV = "TFOS_TELEMETRY_DIR"
+SPOOL_ENV = "TFOS_TELEMETRY_SPOOL"
+NODE_ENV = "TFOS_TELEMETRY_NODE"
+ROLE_ENV = "TFOS_TELEMETRY_ROLE"
+BUFFER_ENV = "TFOS_TELEMETRY_BUFFER"
+FLUSH_ENV = "TFOS_TELEMETRY_FLUSH"
+
+SCHEMA_KEYS = ("ts", "node_id", "role", "kind", "name", "dur_ms", "attrs")
+
+
+class Recorder:
+    """Per-process span/event sink: bounded buffer -> one JSONL file."""
+
+    def __init__(self, sink_dir, node_id=None, role=None):
+        self.sink_dir = sink_dir
+        self.pid = os.getpid()
+        self.node_id = (node_id or os.environ.get(NODE_ENV)
+                        or f"{socket.gethostname()}-{self.pid}")
+        self.role = role or os.environ.get(ROLE_ENV) or "proc"
+        self.path = os.path.join(
+            sink_dir, f"{_safe(self.node_id)}-{self.pid}.jsonl")
+        cap = int(os.environ.get(BUFFER_ENV, "4096"))
+        self._flush_every = int(os.environ.get(FLUSH_ENV, "128"))
+        self._buf = collections.deque(maxlen=max(cap, 1))
+        self._lock = threading.Lock()
+        self._last_flush = time.monotonic()
+        self._sink_warned = False
+        self.dropped = 0
+        # atexit covers plain interpreters; multiprocessing children
+        # exit via os._exit in Process._bootstrap and run only the
+        # util.Finalize registry — register with both so a spawned or
+        # forked trainer's tail records always reach the file.
+        atexit.register(self.flush)
+        try:
+            from multiprocessing import util as _mputil
+
+            _mputil.Finalize(self, Recorder.flush, args=(self,),
+                             exitpriority=100)
+        except Exception:  # noqa: BLE001 - atexit alone is acceptable
+            pass
+
+    def record(self, kind, name, ts, dur_ms, attrs):
+        rec = {
+            "ts": ts,
+            "node_id": self.node_id,
+            "role": self.role,
+            "kind": kind,
+            "name": name,
+            "dur_ms": dur_ms,
+            "attrs": attrs or {},
+        }
+        with self._lock:
+            if len(self._buf) == self._buf.maxlen:
+                self.dropped += 1
+            self._buf.append(rec)
+            need = (len(self._buf) >= self._flush_every
+                    or time.monotonic() - self._last_flush > 1.0)
+        if need:
+            self.flush()
+
+    def flush(self):
+        if os.getpid() != self.pid:
+            # A fork child inherits the parent's atexit/Finalize entries
+            # (and any buffered records): flushing here would duplicate
+            # the parent's records under the parent's filename.
+            return
+        with self._lock:
+            if not self._buf:
+                return
+            recs = list(self._buf)
+            self._buf.clear()
+            dropped, self.dropped = self.dropped, 0
+            self._last_flush = time.monotonic()
+        if dropped:
+            recs.insert(0, {
+                "ts": time.time(), "node_id": self.node_id,
+                "role": self.role, "kind": "event",
+                "name": "telemetry/dropped", "dur_ms": None,
+                "attrs": {"count": dropped},
+            })
+        try:
+            os.makedirs(self.sink_dir, exist_ok=True)
+            data = "".join(
+                json.dumps(r, default=str) + "\n" for r in recs)
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write(data)
+        except OSError as e:
+            if not self._sink_warned:  # degrade quietly, never crash
+                self._sink_warned = True
+                logger.warning("telemetry sink unwritable (%s): %s",
+                               self.path, e)
+
+
+def _safe(name):
+    return "".join(c if (c.isalnum() or c in "-_.") else "_"
+                   for c in str(name)) or "node"
+
+
+# Cached per (pid, dir, spool, node, role): a fork/spawn child or an env
+# change (tests, node_configure) transparently gets a fresh recorder.
+_STATE = {"key": None, "rec": None}
+_STATE_LOCK = threading.Lock()
+
+
+def _get():
+    key = (os.getpid(), os.environ.get(DIR_ENV),
+           os.environ.get(SPOOL_ENV), os.environ.get(NODE_ENV),
+           os.environ.get(ROLE_ENV))
+    if _STATE["key"] == key:
+        return _STATE["rec"]
+    with _STATE_LOCK:
+        if _STATE["key"] == key:
+            return _STATE["rec"]
+        old = _STATE["rec"]
+        if old is not None and old.pid == os.getpid():
+            old.flush()  # reconfigure in-process: don't strand records
+        base = key[1]
+        rec = Recorder(key[2] or base) if base else None
+        _STATE["rec"] = rec
+        _STATE["key"] = key
+        return rec
+
+
+def enabled():
+    """True when telemetry is recording in this process."""
+    return _get() is not None
+
+
+def sink_path():
+    """This process's JSONL sink path, or None when disabled."""
+    rec = _get()
+    return rec.path if rec is not None else None
+
+
+def configure(node_id=None, role=None, spool=None):
+    """Pin identity/sink via the env channel so forked and spawned
+    children inherit them; returns the active recorder (or None)."""
+    if node_id is not None:
+        os.environ[NODE_ENV] = str(node_id)
+    if role is not None:
+        os.environ[ROLE_ENV] = str(role)
+    if spool is not None:
+        os.environ[SPOOL_ENV] = str(spool)
+    return _get()
+
+
+class _NullSpan:
+    """Shared no-op span: the disabled path allocates nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def add(self, **attrs):
+        return self
+
+
+_NULL = _NullSpan()
+
+
+class Span:
+    """Context manager measuring one span on the monotonic clock."""
+
+    __slots__ = ("_rec", "name", "attrs", "_ts", "_t0")
+
+    def __init__(self, rec, name, attrs):
+        self._rec = rec
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        self._ts = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def add(self, **attrs):
+        self.attrs.update(attrs)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur_ms = (time.perf_counter() - self._t0) * 1000.0
+        if exc_type is not None:
+            self.attrs.setdefault("error", repr(exc)[:200])
+        self._rec.record("span", self.name, self._ts, dur_ms, self.attrs)
+        return False
+
+
+def span(name, **attrs):
+    """``with telemetry.span("phase/name", k=v) as s: ...`` — records a
+    span on exit (exceptions annotate ``attrs.error`` and propagate)."""
+    rec = _get()
+    if rec is None:
+        return _NULL
+    return Span(rec, name, attrs)
+
+
+def event(name, **attrs):
+    """Record an instant event (``dur_ms`` null)."""
+    rec = _get()
+    if rec is not None:
+        rec.record("event", name, time.time(), None, attrs)
+
+
+def record_span(name, dur_s, **attrs):
+    """Record an already-measured duration as a span whose start is
+    back-dated by ``dur_s`` — for call sites that time themselves (the
+    feed wait path, TrainMetrics.step) so telemetry and the counters
+    report the SAME number."""
+    rec = _get()
+    if rec is not None:
+        rec.record("span", name, time.time() - dur_s, dur_s * 1000.0,
+                   attrs)
+
+
+def flush():
+    """Flush this process's buffered records to the JSONL sink."""
+    rec = _get()
+    if rec is not None:
+        rec.flush()
+
+
+def run_dir(cluster_id):
+    """The per-run collection directory under TFOS_TELEMETRY_DIR that
+    the driver drain fills at shutdown, or None when disabled."""
+    base = os.environ.get(DIR_ENV)
+    if not base:
+        return None
+    return os.path.join(base, f"run-{int(cluster_id) & 0xffffffff:x}")
+
+
+def register_with(mgr):
+    """Advertise this process's spool dir in the executor manager's KV
+    (the telemetry drain channel, manager.py) so the driver-side drain
+    can collect every node file at shutdown.  Best-effort: telemetry
+    must never take a worker down."""
+    rec = _get()
+    if rec is None:
+        return
+    try:
+        mgr.telemetry_register(os.path.abspath(rec.sink_dir))
+    except Exception as e:  # noqa: BLE001 - drain is best-effort
+        logger.debug("telemetry spool registration failed: %s", e)
+
+
+def read_spool(spool_dir):
+    """[(filename, jsonl_text), ...] for every record file in a spool —
+    the executor-side half of the drain (see node.drain_telemetry)."""
+    out = []
+    try:
+        names = sorted(os.listdir(spool_dir))
+    except OSError:
+        return out
+    for name in names:
+        if not name.endswith(".jsonl"):
+            continue
+        try:
+            with open(os.path.join(spool_dir, name),
+                      encoding="utf-8") as f:
+                out.append((name, f.read()))
+        except OSError as e:
+            logger.warning("telemetry drain: unreadable %s: %s", name, e)
+    return out

@@ -152,6 +152,89 @@ def test_init_watchdog_fires_on_relay_death():
     assert line["value"] is None
 
 
+def test_tunnel_grace_honors_sub_5s_wait():
+    """TFOS_BENCH_TUNNEL_WAIT below the 5s probe tick must be honored:
+    the old sleep(5)-then-probe loop turned wait=1 into a 5s+ stall
+    (and wait=7 into 10s).  The loop now probes first and sleeps only
+    min(5, remaining)."""
+    code = (
+        "import sys, time; sys.path.insert(0, %r); import bench; "
+        "t0 = time.monotonic()\n"
+        "try:\n"
+        "    bench._tunnel_note()\n"
+        "except SystemExit:\n"
+        "    pass\n"
+        "print('GRACE_ELAPSED', time.monotonic() - t0)" % REPO)
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO, env=_dead_tunnel_env(),  # helper pins TUNNEL_WAIT=1
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    elapsed = float(proc.stdout.split("GRACE_ELAPSED")[1].strip())
+    assert 0.9 <= elapsed < 4.0, f"grace loop took {elapsed:.1f}s for wait=1"
+    assert _last_json_line(proc.stdout)["error"] == "tunnel_dead"
+
+
+def test_init_watchdog_ignore_tunnel_skips_port_trigger():
+    """TFOS_BENCH_IGNORE_TUNNEL=1 means the operator overruled the probe
+    heuristic — the port trigger (would fire ~15s in) must stand down,
+    while the wedge time cap stays armed.  With the cap at 18s, a still-
+    armed port trigger would fire FIRST with tunnel_died_during_init."""
+    code = (
+        "import sys, time; sys.path.insert(0, %r); import bench; "
+        "bench._arm_init_watchdog(); time.sleep(120)" % REPO)
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO,
+        env=_dead_tunnel_env(TFOS_BENCH_IGNORE_TUNNEL="1",
+                             TFOS_BENCH_INIT_TIMEOUT="18"),
+        capture_output=True, text=True, timeout=90)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    line = _last_json_line(proc.stdout)
+    assert line["error"] == "backend_init_timeout", line
+    assert line["value"] is None
+
+
+def test_run_watchdog_fires_with_partial_results():
+    """Relay death AFTER _init_done() (mid-lane): the run watchdog must
+    emit the fail-safe line carrying the lane results accumulated so
+    far, then hard-exit 0."""
+    code = (
+        "import sys, time; sys.path.insert(0, %r); import bench; "
+        "extra = {'images_per_sec_per_chip': 123.4}; "
+        "bench._arm_run_watchdog(extra); time.sleep(120)" % REPO)
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO, env=_dead_tunnel_env(),
+        capture_output=True, text=True, timeout=90)
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert elapsed < 40, f"run watchdog took {elapsed:.0f}s"
+    line = _last_json_line(proc.stdout)
+    assert line["error"] == "tunnel_died_mid_run"
+    assert line["value"] is None
+    assert line["extra"]["partial"] is True
+    assert line["extra"]["images_per_sec_per_chip"] == 123.4
+
+
+def test_run_watchdog_noop_under_ignore_tunnel():
+    """The press-on opt-out disarms the mid-run port watchdog too (no
+    background thread at all, so nothing can fire later)."""
+    code = (
+        "import sys, threading; sys.path.insert(0, %r); import bench; "
+        "n0 = threading.active_count(); "
+        "disarm = bench._arm_run_watchdog({}); "
+        "assert threading.active_count() == n0, 'watchdog thread started'; "
+        "disarm(); print('NO_THREAD')" % REPO)
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO, env=_dead_tunnel_env(TFOS_BENCH_IGNORE_TUNNEL="1"),
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "NO_THREAD" in proc.stdout
+
+
 def test_init_watchdog_fires_on_wedge():
     """A relay that dies between probe and backend init wedges the jax
     import (r4: 26 min inside the driver timeout).  The watchdog must

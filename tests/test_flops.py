@@ -49,6 +49,18 @@ def test_depthwise_conv_groups():
     assert r["macs"] == 1 * 4 * 4 * 8 * 9
 
 
+def test_batch_grouped_conv_groups():
+    def f(x, w):
+        return lax.conv_general_dilated(
+            x, w, (1, 1), "SAME", batch_group_count=2,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    r = count_flops(f, jnp.ones((4, 4, 4, 2)), jnp.ones((3, 3, 2, 4)))
+    # batch groups shrink the OUTPUT batch (4/2=2), not the per-output
+    # contraction: out (2,4,4,4) x 9 taps x 2 in_ch
+    assert r["macs"] == (2 * 4 * 4 * 4) * 9 * 2
+
+
 def test_conv_transpose_counts_required_work_only():
     def f(x, w):
         return lax.conv_transpose(

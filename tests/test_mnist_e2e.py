@@ -32,6 +32,7 @@ def mnist_main(args, ctx):
     from tensorflowonspark_tpu.models import mnist
     from tensorflowonspark_tpu.parallel import make_mesh, local_to_global
     from tensorflowonspark_tpu.utils import checkpoint as ckpt
+    from tensorflowonspark_tpu.utils.metrics import TrainMetrics
 
     env = ctx.jax_initialize()
     assert env["num_processes"] == 2, env
@@ -43,7 +44,10 @@ def mnist_main(args, ctx):
     opt_state = opt.init(params)
     step_fn = jax.jit(mnist.make_train_step(opt))
 
-    feed = ctx.get_data_feed(train_mode=True)
+    # metrics feed both report() and (when TFOS_TELEMETRY_DIR is set)
+    # the train/step + feed/wait spans that trace_merge aggregates
+    metrics = TrainMetrics()
+    feed = ctx.get_data_feed(train_mode=True, metrics=metrics)
     losses = []
     per_proc = BATCH // env["num_processes"]
     while not feed.should_stop():
@@ -55,6 +59,7 @@ def mnist_main(args, ctx):
         gimages, glabels = local_to_global(mesh, (images, labels))
         params, opt_state, loss, acc = step_fn(params, opt_state, gimages, glabels)
         losses.append(float(loss))
+        metrics.step(per_proc)
 
     assert len(losses) >= 5, f"too few steps ran: {len(losses)}"
     first, last = np.mean(losses[:3]), np.mean(losses[-3:])
@@ -65,7 +70,13 @@ def mnist_main(args, ctx):
 
 
 @pytest.mark.slow
-def test_mnist_spark_mode_e2e(tmp_path):
+def test_mnist_spark_mode_e2e(tmp_path, monkeypatch):
+    from tensorflowonspark_tpu.utils import telemetry
+
+    # opt-in telemetry for the whole run (driver + executors + trainers):
+    # the acceptance path is this e2e followed by scripts/trace_merge.py
+    telemetry_dir = tmp_path / "telemetry"
+    monkeypatch.setenv(telemetry.DIR_ENV, str(telemetry_dir))
     engine = LocalEngine(
         2,
         env={
@@ -110,5 +121,35 @@ def test_mnist_spark_mode_e2e(tmp_path):
         params, meta = load_exported(export)
         assert meta["format"] == "tfos-tpu-export-v1"
         assert params["conv1"]["w"].shape == (3, 3, 1, 32)
+
+        # --- telemetry: drained run dir -> Chrome trace + summary -------
+        runs = [d for d in os.listdir(telemetry_dir)
+                if d.startswith("run-")]
+        assert len(runs) == 1, f"expected one drained run dir: {runs}"
+        import subprocess
+        import sys
+
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.dirname(
+                 os.path.abspath(__file__))), "scripts", "trace_merge.py"),
+             str(telemetry_dir)],
+            capture_output=True, text=True,
+            env=dict(os.environ, PYTHONPATH=""), timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        import json
+
+        trace = json.loads(
+            (telemetry_dir / "trace.json").read_text())
+        names = {e["name"] for e in trace["traceEvents"]}
+        assert {"cluster/start", "node/boot", "train/step",
+                "feed/wait", "checkpoint/export"} <= names
+        # per-node step percentiles + infeed-stall fraction made it into
+        # the summary for both training nodes (master_node="chief")
+        assert "chief-0" in proc.stdout and "worker-0" in proc.stdout
+        assert "p50_ms" in proc.stdout and "stall" in proc.stdout
     finally:
         engine.stop()
+        for k in (telemetry.NODE_ENV, telemetry.ROLE_ENV,
+                  telemetry.SPOOL_ENV):
+            os.environ.pop(k, None)  # cluster.run pinned driver identity

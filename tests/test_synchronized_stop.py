@@ -16,6 +16,10 @@ def _worker(rank, port, counts, q):
     os.environ.pop("PYTHONPATH", None)
     import jax
 
+    # multi-process SPMD on the CPU backend needs the gloo collectives
+    # implementation (same fix as node.jax_initialize); without it every
+    # collective raises "Multiprocess computations aren't implemented"
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
     jax.distributed.initialize(
         coordinator_address=f"127.0.0.1:{port}",
         num_processes=len(counts),

@@ -1,0 +1,344 @@
+"""utils.telemetry + scripts/trace_merge: span recording, spawn/fork
+safety, driver-side drain, and the Chrome-trace merge.
+
+Parity framing: the reference's observability is log lines only
+(reference ``__init__.py:1-5``, SURVEY.md §5); these tests pin the
+structured replacement — one schema everywhere, no files when disabled,
+every node's records collected into one run directory at shutdown.
+"""
+
+import importlib.util
+import json
+import multiprocessing as mp
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tensorflowonspark_tpu import cluster as TFCluster
+from tensorflowonspark_tpu.cluster import InputMode
+from tensorflowonspark_tpu.engine import LocalEngine, TaskError
+from tensorflowonspark_tpu.utils import telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRACE_MERGE = os.path.join(REPO, "scripts", "trace_merge.py")
+
+_ENV_KEYS = (telemetry.DIR_ENV, telemetry.SPOOL_ENV, telemetry.NODE_ENV,
+             telemetry.ROLE_ENV, telemetry.BUFFER_ENV, telemetry.FLUSH_ENV)
+
+
+def _load_trace_merge():
+    spec = importlib.util.spec_from_file_location("trace_merge", TRACE_MERGE)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_env():
+    """Isolate every test from ambient telemetry env AND restore it:
+    cluster.run/configure write identity into os.environ by design."""
+    saved = {k: os.environ.get(k) for k in _ENV_KEYS}
+    for k in _ENV_KEYS:
+        os.environ.pop(k, None)
+    yield
+    telemetry.flush()
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def _records(path):
+    with open(path, encoding="utf-8") as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def _all_records(root):
+    out = []
+    for dirpath, _dirs, files in os.walk(root):
+        for name in sorted(files):
+            if name.endswith(".jsonl"):
+                out.extend(_records(os.path.join(dirpath, name)))
+    return out
+
+
+# --- recorder core ----------------------------------------------------------
+
+def test_disabled_is_noop(tmp_path):
+    assert not telemetry.enabled()
+    assert telemetry.sink_path() is None
+    assert telemetry.span("x") is telemetry._NULL
+    with telemetry.span("x", a=1) as sp:
+        sp.add(b=2)
+    telemetry.event("y")
+    telemetry.record_span("z", 0.1)
+    telemetry.flush()
+    assert list(tmp_path.iterdir()) == []  # nothing anywhere
+
+
+def test_span_schema_nesting_and_monotonic_clocks(tmp_path):
+    os.environ[telemetry.DIR_ENV] = str(tmp_path)
+    telemetry.configure(node_id="t-0", role="test")
+    assert telemetry.enabled()
+    with telemetry.span("outer", phase="a"):
+        time.sleep(0.02)
+        with telemetry.span("inner") as sp:
+            time.sleep(0.01)
+            sp.add(marker=1)
+    telemetry.event("tick", n=3)
+    telemetry.flush()
+
+    path = telemetry.sink_path()
+    assert os.path.basename(path) == f"t-0-{os.getpid()}.jsonl"
+    recs = _records(path)
+    assert [set(r) for r in recs] == [set(telemetry.SCHEMA_KEYS)] * 3
+    by_name = {r["name"]: r for r in recs}
+    inner, outer, tick = by_name["inner"], by_name["outer"], by_name["tick"]
+    assert outer["kind"] == "span" and tick["kind"] == "event"
+    assert tick["dur_ms"] is None
+    assert inner["attrs"] == {"marker": 1}
+    # monotonic-clock durations, wall-clock anchors: the inner span
+    # starts after and ends before the outer one
+    assert outer["dur_ms"] >= inner["dur_ms"] >= 9.0
+    assert outer["ts"] <= inner["ts"] <= tick["ts"]
+    assert inner["ts"] + inner["dur_ms"] / 1e3 <= \
+        outer["ts"] + outer["dur_ms"] / 1e3 + 0.01
+    assert all(r["node_id"] == "t-0" and r["role"] == "test" for r in recs)
+
+
+def test_record_span_backdates_start(tmp_path):
+    os.environ[telemetry.DIR_ENV] = str(tmp_path)
+    telemetry.configure(node_id="t-0", role="test")
+    before = time.time()
+    telemetry.record_span("train/step", 1.5, items=8)
+    telemetry.flush()
+    (rec,) = _records(telemetry.sink_path())
+    assert rec["dur_ms"] == pytest.approx(1500.0)
+    # self-timed spans anchor at START so the trace lays them out right
+    assert rec["ts"] == pytest.approx(before - 1.5, abs=0.25)
+
+
+def test_span_error_annotates_and_propagates(tmp_path):
+    os.environ[telemetry.DIR_ENV] = str(tmp_path)
+    telemetry.configure(node_id="t-0", role="test")
+    with pytest.raises(ValueError, match="boom"):
+        with telemetry.span("will/fail"):
+            raise ValueError("boom")
+    telemetry.flush()
+    (rec,) = _records(telemetry.sink_path())
+    assert "boom" in rec["attrs"]["error"]
+
+
+def test_ring_buffer_counts_drops(tmp_path):
+    os.environ[telemetry.DIR_ENV] = str(tmp_path)
+    os.environ[telemetry.BUFFER_ENV] = "4"
+    os.environ[telemetry.FLUSH_ENV] = "1000"  # no threshold flush
+    telemetry.configure(node_id="t-0", role="test")
+    for i in range(10):
+        telemetry.event("e", i=i)
+    telemetry.flush()
+    recs = _records(telemetry.sink_path())
+    dropped = [r for r in recs if r["name"] == "telemetry/dropped"]
+    assert dropped and dropped[0]["attrs"]["count"] >= 1
+    assert len([r for r in recs if r["name"] == "e"]) <= 4
+
+
+def _spawn_child_emit():
+    # relies on the exit-time Finalize/atexit flush: NO explicit flush
+    from tensorflowonspark_tpu.utils import telemetry as t
+
+    with t.span("spawn/child", pid=os.getpid()):
+        pass
+
+
+def test_spawn_child_roundtrip(tmp_path):
+    """A spawned child inherits the env channel, writes its own
+    <node>-<pid>.jsonl, and its exit hook flushes without help."""
+    os.environ[telemetry.DIR_ENV] = str(tmp_path)
+    telemetry.configure(node_id="parent", role="test")
+    ctx = mp.get_context("spawn")
+    p = ctx.Process(target=_spawn_child_emit)
+    p.start()
+    p.join(60)
+    assert p.exitcode == 0
+    child = [r for r in _all_records(tmp_path) if r["name"] == "spawn/child"]
+    assert len(child) == 1
+    assert child[0]["attrs"]["pid"] == p.pid
+    assert child[0]["node_id"] == "parent"  # identity inherited via env
+    files = sorted(f.name for f in tmp_path.iterdir())
+    assert f"parent-{p.pid}.jsonl" in files
+
+
+# --- cluster drain ----------------------------------------------------------
+
+def _telemetry_node_fn(args, ctx):
+    from tensorflowonspark_tpu.utils import telemetry as t
+
+    with t.span("user/work", task=ctx.task_index):
+        time.sleep(0.01)
+
+
+def _fail_after_feed_fn(args, ctx):
+    feed = ctx.get_data_feed(train_mode=True)
+    while not feed.should_stop():
+        feed.next_batch(100)
+    raise RuntimeError("deliberate failure after feeding")
+
+
+def _run_dirs(root):
+    return sorted(d for d in os.listdir(root) if d.startswith("run-"))
+
+
+def test_drain_on_clean_shutdown(tmp_path):
+    os.environ[telemetry.DIR_ENV] = str(tmp_path)
+    engine = LocalEngine(2)
+    try:
+        cluster = TFCluster.run(
+            engine, _telemetry_node_fn, [], num_executors=2,
+            input_mode=InputMode.TENSORFLOW,
+        )
+        cluster.shutdown()
+    finally:
+        engine.stop()
+    (run,) = _run_dirs(tmp_path)
+    drained = _all_records(tmp_path / run)
+    names = {r["name"] for r in drained}
+    # node lifecycle + user spans all collected into the one run dir
+    assert {"node/boot", "node/main", "user/work",
+            "rendezvous/register"} <= names
+    assert {r["node_id"] for r in drained if r["name"] == "user/work"} == \
+        {"worker-0", "worker-1"}
+    # the driver's own spans land in the root (cluster/start before the
+    # run id exists; the drain span itself covers the collection)
+    driver = [r for r in _all_records(tmp_path)
+              if r["role"] == "driver"]
+    dnames = {r["name"] for r in driver}
+    assert {"cluster/start", "cluster/shutdown",
+            "cluster/telemetry_drain"} <= dnames
+
+
+def test_drain_on_error_shutdown(tmp_path):
+    """A failing node program must still get its telemetry drained —
+    the error path is exactly when the timeline matters most."""
+    os.environ[telemetry.DIR_ENV] = str(tmp_path)
+    engine = LocalEngine(2)
+    try:
+        cluster = TFCluster.run(
+            engine, _fail_after_feed_fn, [], num_executors=2,
+            input_mode=InputMode.SPARK,
+        )
+        ds = engine.parallelize(range(100), 2)
+        cluster.train(ds)
+        with pytest.raises((TaskError, SystemExit)) as ei:
+            cluster.shutdown(grace_secs=3)
+    finally:
+        engine.stop()
+    (run,) = _run_dirs(tmp_path)
+    names = {r["name"] for r in _all_records(tmp_path / run)}
+    assert "node/boot" in names
+    driver = {r["name"] for r in _all_records(tmp_path)
+              if r["role"] == "driver"}
+    assert "cluster/shutdown" in driver
+    if isinstance(ei.value, SystemExit):
+        # the tf_status error path emits the cluster/error event before
+        # cancelling jobs (a TaskError from the stop-job raises earlier)
+        assert "cluster/error" in driver
+
+
+def test_telemetry_disabled_cluster_writes_nothing(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)  # catch stray spool dirs too
+    engine = LocalEngine(2)
+    try:
+        cluster = TFCluster.run(
+            engine, _telemetry_node_fn, [], num_executors=2,
+            input_mode=InputMode.TENSORFLOW,
+        )
+        cluster.shutdown()
+    finally:
+        engine.stop()
+    assert not list(tmp_path.glob("**/*.jsonl"))
+    assert not (tmp_path / ".tfos_telemetry").exists()
+
+
+# --- trace merge ------------------------------------------------------------
+
+def _synthesize(tmp_path):
+    os.environ[telemetry.DIR_ENV] = str(tmp_path)
+    for node, role in (("worker-0", "worker"), ("worker-1", "worker")):
+        telemetry.configure(node_id=node, role=role)
+        for i in range(10):
+            telemetry.record_span(
+                "train/step", 0.010 + 0.001 * i, items=32,
+                flops_per_item=2.0e9, peak_flops=197e12)
+            telemetry.record_span("feed/wait", 0.002, eof=False)
+        telemetry.event("node/tb_spawn", port=6006)
+        telemetry.flush()
+
+
+def test_trace_merge_golden(tmp_path):
+    _synthesize(tmp_path)
+    tm = _load_trace_merge()
+    pairs, skipped = tm.load_records(str(tmp_path))
+    assert skipped == 0 and len(pairs) == 42
+    assert [p[0]["ts"] for p in pairs] == \
+        sorted(p[0]["ts"] for p in pairs)
+
+    trace = tm.to_chrome_trace(pairs)
+    evs = trace["traceEvents"]
+    phases = {e["ph"] for e in evs}
+    assert phases == {"M", "X", "i"}
+    procs = {e["args"]["name"] for e in evs
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert procs == {"worker-0 (worker)", "worker-1 (worker)"}
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert len(xs) == 40
+    assert all(e["ts"] >= 0 and e["dur"] > 0 for e in xs)
+
+    text, stats = tm.summarize(pairs, skipped)
+    assert stats["phases"]["train/step"]["count"] == 20
+    for node in ("worker-0", "worker-1"):
+        n = stats["nodes"][node]
+        assert n["steps"] == 10
+        assert n["p50_ms"] == pytest.approx(14.0, abs=0.1)
+        assert n["p99_ms"] >= n["p90_ms"] >= n["p50_ms"]
+        # 10 x 2ms waits in a ~165ms loop (145ms steps + 20ms waits)
+        assert n["infeed_stall_frac"] == pytest.approx(20 / 165, abs=0.02)
+        # mfu = items*flops / (time * peak)
+        assert n["mfu"] == pytest.approx(
+            (10 * 32 * 2.0e9) / (n["step_total_s"] * 197e12), rel=1e-6)
+    assert "train/step" in text and "worker-1" in text
+
+
+def test_trace_merge_skips_malformed_lines(tmp_path):
+    _synthesize(tmp_path)
+    bad = tmp_path / "torn-123.jsonl"
+    bad.write_text('{"ts": 1.0, "half a record...\nnot json\n')
+    tm = _load_trace_merge()
+    pairs, skipped = tm.load_records(str(tmp_path))
+    assert len(pairs) == 42 and skipped == 2
+
+
+def test_trace_merge_cli(tmp_path):
+    _synthesize(tmp_path)
+    env = dict(os.environ, PYTHONPATH="")
+    proc = subprocess.run(
+        [sys.executable, TRACE_MERGE, str(tmp_path),
+         "--summary-out", str(tmp_path / "summary.txt")],
+        capture_output=True, text=True, env=env, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "per-node train steps" in proc.stdout
+    trace = json.loads((tmp_path / "trace.json").read_text())
+    assert trace["traceEvents"]
+    assert "worker-0" in (tmp_path / "summary.txt").read_text()
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    proc = subprocess.run(
+        [sys.executable, TRACE_MERGE, str(empty)],
+        capture_output=True, text=True, env=env, timeout=60)
+    assert proc.returncode == 1
+    assert "no telemetry records" in proc.stderr
