@@ -54,12 +54,26 @@ class TFCluster:
     input_mode = None
     queues = None
     server = None
+    restarts = 0
+    _restarts_used = 0
+    _node_fn = None
+    _nodes_ds = None
+    _node_ids = None
 
     def train(self, dataset, num_epochs=1, feed_timeout=600, qname="input"):
         """Feed a dataset into the cluster (parity: TFCluster.train :63-94).
 
         Epochs are realized by unioning the dataset with itself — the exact
         reference mechanism (TFCluster.py:88-93).
+
+        With ``run(..., restarts=N)`` this call supervises the whole job:
+        a lost worker fails the feed job, which triggers recovery —
+        quiesce survivors, bump the cluster epoch, relaunch nodes on a
+        replenished executor pool, and re-feed only the partitions the
+        rendezvous ledger has not recorded as fully consumed (trainers
+        resume from their latest checkpoint via
+        ``ctx.restore_latest``) — up to N times before the error
+        propagates.
         """
         logger.info("feeding training data")
         assert self.input_mode == InputMode.SPARK, "train() requires InputMode.SPARK"
@@ -77,14 +91,94 @@ class TFCluster:
         assert num_epochs >= 0, "num_epochs cannot be negative"
         if num_epochs > 1:
             ds = ds.union(*[ds for _ in range(num_epochs - 1)])
-        # spread=True round-robins partitions across executors so SPMD
-        # consumers see balanced feeds (uneven feeds would stall the
-        # synchronous gradient all-reduce; cf. the reference's "90% of
-        # steps" workaround, examples/mnist/keras/mnist_spark.py:58-66).
-        ds.foreach_partition(
-            node.train(self.cluster_info, self.cluster_meta, feed_timeout, qname),
-            spread=True,
-        )
+        # this job's consumption ledger starts empty: partitions consumed
+        # by a previous train() on this cluster must not be skipped here
+        self.server.reset_feed(qname)
+        while True:
+            # partitions fully consumed before a mid-job failure are not
+            # re-fed after recovery (exactly-once per partition)
+            done = set(self.server.fed_partitions(qname))
+            if done:
+                logger.info("resuming feed: %d partitions already "
+                            "consumed: %s", len(done), sorted(done))
+            feeder = node.train(self.cluster_info, self.cluster_meta,
+                                feed_timeout, qname, skip=done)
+            # spread=True round-robins partitions across executors so SPMD
+            # consumers see balanced feeds (uneven feeds would stall the
+            # synchronous gradient all-reduce; cf. the reference's "90% of
+            # steps" workaround, examples/mnist/keras/mnist_spark.py:58-66).
+            try:
+                ds.foreach_partition(feeder, spread=True,
+                                     retryable=self.restarts > 0)
+                return
+            except (engine_mod.TaskError, RuntimeError, TimeoutError) as e:
+                if self._restarts_used >= self.restarts:
+                    raise
+                self._recover(e)
+
+    def _spawn_launcher(self):
+        """(Re)launch the node job on a background thread
+        (TFCluster.py:317-334); also the relaunch half of recovery."""
+
+        def _launch():
+            try:
+                self._nodes_ds.foreach_partition(
+                    self._node_fn, placement=self._node_ids,
+                    retryable=self.restarts > 0)
+            except Exception as e:  # noqa: BLE001
+                logger.exception("node launch failed")
+                tf_status["error"] = str(e)
+
+        t = threading.Thread(target=_launch, daemon=True,
+                             name="tfos-launcher")
+        t.start()
+        return t
+
+    def _recover(self, err):
+        """One supervised restart: tear the failed incarnation down and
+        bring up the next epoch (SURVEY.md §5 'restart job from
+        checkpoint', made automatic).
+
+        Order matters: (1) quiesce every surviving node — state ->
+        terminating, poison its error queue so orphan feeders still
+        blocked in await-consumption fail out and release their executor
+        slots, kill the background trainer; (2) respawn dead executors so
+        the relaunch sees a full pool; (3) bump the epoch on the
+        rendezvous server BEFORE joining the old launcher, so any stale
+        in-flight re-registration REJECTS instead of contaminating the
+        new reservation table; (4) relaunch and await the new
+        incarnation."""
+        self._restarts_used += 1
+        epoch = int(self.meta.get("epoch", 0)) + 1
+        telemetry.event("cluster/recover_begin", epoch=epoch,
+                        restart=self._restarts_used,
+                        restarts=self.restarts, error=str(err)[:400])
+        logger.warning(
+            "cluster failure (%s); recovery %d/%d -> epoch %d",
+            str(err)[:200], self._restarts_used, self.restarts, epoch)
+        with telemetry.span("cluster/recover", epoch=epoch,
+                            restart=self._restarts_used):
+            for m in self.cluster_info:
+                _quiesce_node(m)
+            if hasattr(self.engine, "ensure_executors"):
+                self.engine.ensure_executors()
+            self.meta["epoch"] = epoch  # node closures read this dict
+            self.server.reset(epoch)
+            if self._launcher is not None:
+                self._launcher.join(timeout=60)
+                if self._launcher.is_alive():
+                    logger.warning(
+                        "old launcher still running after 60s; relaunching "
+                        "anyway (stale registrations are epoch-fenced)")
+            tf_status.pop("error", None)
+            self._launcher = self._spawn_launcher()
+            self.cluster_info = _await_cluster(
+                self.server, tf_status,
+                self.meta.get("reservation_timeout", 600))
+        telemetry.event("cluster/recover_done", epoch=epoch,
+                        nodes=len(self.cluster_info))
+        logger.info("recovery complete: epoch %d with %d nodes",
+                    epoch, len(self.cluster_info))
 
     def train_stream(self, stream, feed_timeout=600, qname="input"):
         """Feed a streaming source: an iterable of datasets (micro-batches).
@@ -243,6 +337,67 @@ class TFCluster:
     _launcher = None
 
 
+def _quiesce_node(m):
+    """Drive one (possibly already dead) node incarnation to a terminal
+    state during recovery.  Best-effort throughout — the manager may have
+    died with its executor, and that is fine: the respawn path killed its
+    pid-file children.  Loopback fallback as in ``_stop_remote_node``."""
+    import socket as _socket
+
+    addr = tuple(m["addr"])
+    candidates = [addr]
+    if addr[0] not in ("127.0.0.1", "localhost"):
+        candidates.append(("127.0.0.1", addr[1]))
+    old = _socket.getdefaulttimeout()
+    _socket.setdefaulttimeout(5)
+    try:
+        for cand in candidates:
+            try:
+                mgr = tfmanager.connect(cand, bytes.fromhex(m["authkey"]))
+            except Exception:  # noqa: BLE001 - dead with its executor
+                continue
+            try:
+                mgr.set("state", "terminating")
+                # orphan feeders of the failed job sit in await-consumption
+                # polling this queue (with the state flag covering blocked
+                # puts); the poison makes them raise and free their
+                # executor slot for the relaunch
+                mgr.get_queue("error").put(
+                    "cluster recovery: node quiesced (epoch fence)")
+                bg = mgr.get("bg_pid")
+                if bg:
+                    from tensorflowonspark_tpu.utils import kill_pid
+
+                    kill_pid(int(str(bg)))
+                    mgr.set("bg_pid", None)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("quiesce executor %s: %s",
+                               m["executor_id"], e)
+            return
+        logger.info("quiesce: no manager reachable for executor %s "
+                    "(node already dead)", m["executor_id"])
+    finally:
+        _socket.setdefaulttimeout(old)
+
+
+def _await_cluster(server, status, timeout):
+    """Wait for every node of the (re)launched incarnation to register,
+    then run the duplicate-registration sanity check
+    (TFCluster.py:338,355-370)."""
+    cluster_info = server.await_reservations(status, timeout)
+    seen = set()
+    for m in cluster_info:
+        key = (m["host"], m["executor_id"])
+        if key in seen:
+            raise RuntimeError(f"duplicate node registration for {key}")
+        seen.add(key)
+    logger.info("cluster_info: %s", [
+        (m["job_name"], m["task_index"], m["host"], m["executor_id"])
+        for m in cluster_info
+    ])
+    return cluster_info
+
+
 def _stop_remote_node(m):
     """control.put(None) on a ps/evaluator's remote manager, with a
     connect timeout and a loopback fallback (the advertised host may be
@@ -288,11 +443,20 @@ def run(
     eval_node=False,
     num_chips=0,
     background=None,
+    restarts=0,
 ):
     """Starts the distributed cluster (parity: TFCluster.run :215-383).
 
     Args mirror the reference; ``sc`` may be a pyspark SparkContext or a
     ``LocalEngine``.  ``num_chips`` replaces the implicit GPU count.
+
+    ``restarts``: how many times a failed job may be recovered (teardown,
+    epoch bump, node relaunch, checkpoint auto-resume) before the error
+    propagates.  0 (default) keeps fail-fast semantics.  Supervision
+    applies to ``InputMode.SPARK`` ``train()`` jobs — the feed job is the
+    driver's observation point; TENSORFLOW-mode jobs (nodes read their
+    own data) and streaming feeds are not auto-restarted (see
+    docs/fault_tolerance.md).
     """
     logger.info("Reserving TFSparkNodes-TPU")
     start_t0 = time.perf_counter()
@@ -339,6 +503,7 @@ def run(
 
     cluster_meta = {
         "id": random.getrandbits(64),
+        "epoch": 0,  # cluster incarnation; _recover bumps it in place
         "cluster_template": template,
         "num_executors": num_executors,
         "default_fs": eng.default_fs,
@@ -377,44 +542,27 @@ def run(
                       if not (driver_ps_nodes and i >= num_executors))
     nodes_ds = eng.parallelize(node_ids, len(node_ids))
 
-    def _launch():
-        try:
-            nodes_ds.foreach_partition(node_fn, placement=node_ids)
-        except Exception as e:  # noqa: BLE001
-            logger.exception("node launch failed")
-            tf_status["error"] = str(e)
-
-    launcher = threading.Thread(target=_launch, daemon=True, name="tfos-launcher")
-    launcher.start()
-
-    # wait for all nodes to register (TFCluster.py:338)
-    cluster_info = server.await_reservations(tf_status, reservation_timeout)
-
-    # duplicate (host, executor_id) sanity check (TFCluster.py:355-370)
-    seen = set()
-    for m in cluster_info:
-        key = (m["host"], m["executor_id"])
-        if key in seen:
-            raise RuntimeError(f"duplicate node registration for {key}")
-        seen.add(key)
-    logger.info("cluster_info: %s", [
-        (m["job_name"], m["task_index"], m["host"], m["executor_id"])
-        for m in cluster_info
-    ])
-    telemetry.record_span(
-        "cluster/start", time.perf_counter() - start_t0,
-        cluster=f"{cluster_meta['id'] & 0xffffffff:x}",
-        executors=num_executors, nodes=len(cluster_info))
-
     c = TFCluster()
     c.sc = sc
     c.engine = eng
     c.meta = cluster_meta
     c.cluster_meta = cluster_meta
     c.nodes = nodes_ds
-    c.cluster_info = cluster_info
     c.input_mode = input_mode
     c.queues = queues
     c.server = server
-    c._launcher = launcher
+    c.restarts = int(restarts)
+    c._restarts_used = 0
+    c._node_fn = node_fn
+    c._nodes_ds = nodes_ds
+    c._node_ids = node_ids
+    c._launcher = c._spawn_launcher()
+
+    # wait for all nodes to register (TFCluster.py:338), then the
+    # duplicate (host, executor_id) sanity check (TFCluster.py:355-370)
+    c.cluster_info = _await_cluster(server, tf_status, reservation_timeout)
+    telemetry.record_span(
+        "cluster/start", time.perf_counter() - start_t0,
+        cluster=f"{cluster_meta['id'] & 0xffffffff:x}",
+        executors=num_executors, nodes=len(c.cluster_info))
     return c

@@ -35,9 +35,11 @@ reference's usage):
 from __future__ import annotations
 
 import atexit
+import contextlib
 import logging
 import os
 import queue as _queue
+import random
 import shutil
 import tempfile
 import threading
@@ -47,13 +49,18 @@ import multiprocessing as mp
 
 import cloudpickle
 
-from tensorflowonspark_tpu.utils import telemetry
+from tensorflowonspark_tpu.utils import faults, telemetry
 
 logger = logging.getLogger(__name__)
 
 
 class TaskError(RuntimeError):
     """A task raised on an executor; carries the remote traceback."""
+
+
+class ResultPumpError(TaskError):
+    """The result transport itself failed (corrupt stream, undeliverable
+    payload) — not attributable to any one task's user code."""
 
 
 def _row_bytes(row, _depth=0):
@@ -120,13 +127,26 @@ def _executor_main(index, workdir, shared_inbox, own_inbox, results):
             if msg[0] == "stop":
                 break
             _, job_id, task_id, blob = msg
+            # Start-ack BEFORE execution: the driver uses it to know which
+            # tasks were in flight on an executor that dies, so exactly
+            # those can be re-dispatched after a respawn.
+            results.put(("start", job_id, task_id, index, None))
+            # The feeder closures recover their partition number from this
+            # (engine analogue of pyspark TaskContext.partitionId()).
+            os.environ["TFOS_PARTITION_INDEX"] = str(task_id)
             try:
+                faults.check("engine.task", job=job_id, task=task_id)
                 with telemetry.span("engine/task", job=job_id, task=task_id):
                     fn, items, collect = cloudpickle.loads(blob)
                     out = fn(iter(items))
                     result = (list(out) if (collect and out is not None)
                               else None)
-                results.put(("ok", job_id, task_id, index, result))
+                # Serialize the payload HERE: an unpicklable result then
+                # fails only this task (below) instead of poisoning the
+                # shared results pipe for every in-flight job.
+                payload = (None if result is None
+                           else cloudpickle.dumps(result))
+                results.put(("ok", job_id, task_id, index, payload))
             except BaseException:  # noqa: BLE001 - must report any task failure
                 results.put(("error", job_id, task_id, index, traceback.format_exc()))
     finally:
@@ -197,10 +217,18 @@ class LocalDataset:
     def map_partitions(self, fn):
         return LocalDataset(self._engine, None, lineage=(self, fn))
 
-    def foreach_partition(self, fn, spread=False, placement=None):
+    def foreach_partition(self, fn, spread=False, placement=None,
+                          retryable=False, max_retries=None):
         """Run fn over partitions.  ``placement`` pins task i to executor
         placement[i] (used so shutdown signals reach the executor that owns
-        each node's manager — Spark gets this from locality)."""
+        each node's manager — Spark gets this from locality).
+
+        ``retryable=True`` declares every task idempotent: a failed task
+        is retried with exponential backoff (budget ``max_retries``,
+        default TFOS_TASK_RETRIES) and a dead executor is respawned with
+        its lost tasks re-dispatched, instead of failing the job.  Only
+        the node-placement and feeder closures qualify — arbitrary user
+        jobs keep fail-fast semantics."""
 
         def run(fn_, chain):
             def _run(it, _c=chain, _f=fn_):
@@ -211,7 +239,8 @@ class LocalDataset:
 
         tasks = [(items, run(fn, chain)) for items, chain in self._tasks()]
         self._engine._run_job(tasks, collect=False, spread=spread,
-                              placement=placement)
+                              placement=placement, retryable=retryable,
+                              max_retries=max_retries)
 
     def collect(self, spread=False):
         """Materialize all partitions.  ``spread=True`` pins task i to
@@ -270,6 +299,27 @@ class LocalDataset:
 # Local engine
 # ----------------------------------------------------------------------------
 
+@contextlib.contextmanager
+def _patched_env(env):
+    """Apply env overrides around a spawn; a value of None removes the
+    variable.  Restores os.environ on exit."""
+    saved = {}
+    for k, v in env.items():
+        saved[k] = os.environ.get(k)
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = str(v)
+    try:
+        yield
+    finally:
+        for k, old in saved.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+
+
 class LocalEngine:
     """Multi-process executor pool: the built-in scheduler substrate."""
 
@@ -294,36 +344,19 @@ class LocalEngine:
         self._job_queues = {}  # job_id -> local queue (results demux)
         self._cancelled = False
         self.executor_dirs = []
-        saved = {}
-        for k, v in self._env.items():
-            saved[k] = os.environ.get(k)
-            if v is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = str(v)
-        try:
+        # supervision knobs (foreach_partition(retryable=True) path)
+        self._max_retries = int(os.environ.get("TFOS_TASK_RETRIES", "2"))
+        self._retry_backoff = float(os.environ.get("TFOS_RETRY_BACKOFF", "0.25"))
+        self._respawn_budget = int(os.environ.get("TFOS_EXECUTOR_RESPAWNS", "8"))
+        self._respawns = 0
+        self._spawn_lock = threading.Lock()
+        with _patched_env(self._env):
             for i in range(self.num_executors):
                 d = os.path.join(self._root, f"executor-{i}")
                 os.makedirs(d, exist_ok=True)
                 self.executor_dirs.append(d)
-                inbox = self._ctx.Queue()
-                self._own_inboxes.append(inbox)
-                # NOT daemonic: executors must be able to fork the background
-                # training process and the IPC manager (Spark executors can).
-                p = self._ctx.Process(
-                    target=_executor_main,
-                    args=(i, d, self._shared_inbox, inbox, self._results),
-                    name=f"tfos-executor-{i}",
-                    daemon=False,
-                )
-                p.start()
-                self._procs.append(p)
-        finally:
-            for k, old in saved.items():
-                if old is None:
-                    os.environ.pop(k, None)
-                else:
-                    os.environ[k] = old
+                self._own_inboxes.append(self._ctx.Queue())
+                self._procs.append(self._spawn_executor(i))
         # Concurrent jobs (e.g. the node-launcher thread and a feeder) share
         # one results pipe; this pump demultiplexes per job so one job's
         # wait loop can never swallow another's completions.
@@ -335,6 +368,69 @@ class LocalEngine:
         logger.info(
             "LocalEngine started %d executors under %s", self.num_executors, self._root
         )
+
+    def _spawn_executor(self, index):
+        """Start the executor-``index`` process (reusing its inbox and
+        working dir, so queued-but-unconsumed tasks survive a respawn).
+        NOT daemonic: executors must be able to fork the background
+        training process and the IPC manager (Spark executors can)."""
+        p = self._ctx.Process(
+            target=_executor_main,
+            args=(index, self.executor_dirs[index], self._shared_inbox,
+                  self._own_inboxes[index], self._results),
+            name=f"tfos-executor-{index}",
+            daemon=False,
+        )
+        p.start()
+        return p
+
+    # -- supervision ----------------------------------------------------------
+    def _respawn_executor(self, index):
+        """Replace a dead executor process; True if a respawn happened.
+
+        The dead incarnation's forked children (IPC-manager server,
+        background trainer) are part of its failure domain: they are
+        killed via the executor dir's pid file before the replacement
+        starts, so a relaunched node never fights a half-dead twin for
+        the executor's identity."""
+        from tensorflowonspark_tpu.utils import (
+            clear_child_pids, kill_pid, read_child_pids,
+        )
+
+        with self._spawn_lock:
+            if self._procs[index].is_alive():
+                return False
+            if self._respawns >= self._respawn_budget:
+                raise TaskError(
+                    f"executor {index} died and the respawn budget "
+                    f"(TFOS_EXECUTOR_RESPAWNS={self._respawn_budget}) is "
+                    "exhausted")
+            self._respawns += 1
+            d = self.executor_dirs[index]
+            for pid in read_child_pids(d):
+                if kill_pid(pid, 0):  # still alive
+                    logger.warning(
+                        "respawn: killing orphaned child pid %d of dead "
+                        "executor %d", pid, index)
+                    kill_pid(pid)
+            clear_child_pids(d)
+            with _patched_env(self._env):
+                self._procs[index] = self._spawn_executor(index)
+        telemetry.event("engine/executor_respawn", executor=index,
+                        respawns=self._respawns)
+        logger.warning("respawned executor %d (%d/%d respawns used)",
+                       index, self._respawns, self._respawn_budget)
+        return True
+
+    def ensure_executors(self):
+        """Respawn every dead executor; returns the respawned indices.
+        Used by cluster recovery to heal the pool before relaunching
+        nodes."""
+        respawned = []
+        for i, p in enumerate(self._procs):
+            if not p.is_alive() and self._respawn_executor(i):
+                respawned.append(i)
+        return respawned
 
     # -- engine contract ------------------------------------------------------
     @property
@@ -366,14 +462,18 @@ class LocalEngine:
                 continue
             except (OSError, EOFError, ValueError):
                 break
-            except Exception as e:  # noqa: BLE001 - e.g. result unpickling
-                # A poisoned result must not silently kill the pump (every
-                # job would hang); fail all in-flight jobs instead.
+            except Exception as e:  # noqa: BLE001 - transport corruption
+                # Task results are serialized child-side (so a bad payload
+                # fails only its own task); reaching here means the results
+                # PIPE itself is corrupt.  That must not silently kill the
+                # pump (every job would hang); broadcast a typed transport
+                # error to all in-flight jobs instead.
                 logger.exception("result pump error")
                 with self._job_lock:
                     queues = list(self._job_queues.values())
                 for q in queues:
-                    q.put(("error", None, -1, -1, f"result pump error: {e!r}"))
+                    q.put(("pump_error", None, -1, -1,
+                           f"result pump transport error: {e!r}"))
                 continue
             with self._job_lock:
                 q = self._job_queues.get(item[1])
@@ -381,7 +481,8 @@ class LocalEngine:
                 q.put(item)
             # results for finished/cancelled jobs are dropped
 
-    def _run_job(self, tasks, collect, spread, placement=None):
+    def _run_job(self, tasks, collect, spread, placement=None,
+                 retryable=False, max_retries=None):
         """Dispatch one (items, fn) task per partition; block until done."""
         if self._cancelled:
             raise TaskError("engine cancelled")
@@ -391,63 +492,150 @@ class LocalEngine:
             my_results = _queue.Queue()
             self._job_queues[job_id] = my_results
         with telemetry.span("engine/job", job=job_id, tasks=len(tasks),
-                            spread=bool(spread or placement is not None)):
+                            spread=bool(spread or placement is not None),
+                            retryable=bool(retryable)):
             return self._run_job_inner(
-                tasks, collect, spread, placement, job_id, my_results)
+                tasks, collect, spread, placement, job_id, my_results,
+                retryable, max_retries)
 
     def _run_job_inner(self, tasks, collect, spread, placement, job_id,
-                       my_results):
+                       my_results, retryable=False, max_retries=None):
         # Only executors that die DURING this job abort it; one already lost
         # to an earlier job must not fail work the survivors can finish.
         dead_at_start = {i for i, p in enumerate(self._procs) if not p.is_alive()}
-        try:
-            ntasks = len(tasks)
-            for task_id, (part, fn) in enumerate(tasks):
-                blob = cloudpickle.dumps((fn, list(part), collect))
-                msg = ("task", job_id, task_id, blob)
-                if placement is not None and task_id < len(placement):
-                    target = placement[task_id] % self.num_executors
-                    if not self._procs[target].is_alive():
-                        raise TaskError(
-                            f"cannot place task {task_id} on executor "
-                            f"{target}: executor process is dead"
-                        )
-                    self._own_inboxes[target].put(msg)
-                elif spread:
-                    target = task_id % self.num_executors
-                    if not self._procs[target].is_alive():
-                        raise TaskError(
-                            f"cannot spread task {task_id} to executor "
-                            f"{target}: executor process is dead"
-                        )
-                    self._own_inboxes[target].put(msg)
+        ntasks = len(tasks)
+        if max_retries is None:
+            max_retries = self._max_retries
+        if not retryable:
+            max_retries = 0
+        # Blobs are kept for the job's lifetime when retryable so a failed
+        # or lost task can be re-dispatched byte-identically.
+        blobs = [cloudpickle.dumps((fn, list(part), collect))
+                 for part, fn in tasks]
+
+        def _dispatch(task_id):
+            msg = ("task", job_id, task_id, blobs[task_id])
+            if placement is not None and task_id < len(placement):
+                target = placement[task_id] % self.num_executors
+            elif spread:
+                target = task_id % self.num_executors
+            else:
+                self._shared_inbox.put(msg)
+                return
+            if not self._procs[target].is_alive():
+                if retryable:
+                    # heal the slot: the inbox survives, so the respawned
+                    # executor picks this message up
+                    self._respawn_executor(target)
+                    dead_at_start.discard(target)
+                elif placement is not None:
+                    raise TaskError(
+                        f"cannot place task {task_id} on executor "
+                        f"{target}: executor process is dead"
+                    )
                 else:
-                    self._shared_inbox.put(msg)
-            results = [None] * ntasks
-            done = 0
-            while done < ntasks:
+                    raise TaskError(
+                        f"cannot spread task {task_id} to executor "
+                        f"{target}: executor process is dead"
+                    )
+            self._own_inboxes[target].put(msg)
+
+        results = [None] * ntasks
+        done = [False] * ntasks
+        attempts = [0] * ntasks       # retries consumed per task
+        failures = [[] for _ in range(ntasks)]  # remote tracebacks, in order
+        running = {}                  # task_id -> executor (start-acked)
+        retry_at = {}                 # task_id -> monotonic re-dispatch time
+        ndone = 0
+
+        def _fail_permanently(tid):
+            msg = f"task {tid} failed on executor:\n{failures[tid][-1]}"
+            if len(failures[tid]) > 1:
+                chain = "\n--- earlier attempt ---\n".join(failures[tid][:-1])
+                msg += (f"\n(permanent after {len(failures[tid])} attempts; "
+                        f"earlier attempts:\n{chain})")
+            raise TaskError(msg)
+
+        def _schedule_retry(tid, reason):
+            """Count a failed attempt; queue a backoff re-dispatch or fail
+            the job once the budget is spent (poison task)."""
+            failures[tid].append(reason)
+            running.pop(tid, None)
+            if attempts[tid] >= max_retries:
+                if retryable:
+                    telemetry.event("engine/task_poison", job=job_id,
+                                    task=tid, attempts=attempts[tid] + 1)
+                _fail_permanently(tid)
+            attempts[tid] += 1
+            delay = min(self._retry_backoff * (2 ** (attempts[tid] - 1)), 5.0)
+            delay *= 0.5 + random.random()  # jitter: desynchronize retries
+            retry_at[tid] = time.monotonic() + delay
+            telemetry.event("engine/task_retry", job=job_id, task=tid,
+                            attempt=attempts[tid], delay_ms=int(delay * 1000))
+            logger.warning(
+                "task %d of job %d failed (attempt %d of %d); retrying "
+                "in %.2fs", tid, job_id, attempts[tid], max_retries + 1, delay)
+
+        try:
+            for task_id in range(ntasks):
+                _dispatch(task_id)
+            while ndone < ntasks:
                 if self._cancelled:
                     raise TaskError("engine cancelled")
+                now = time.monotonic()
+                for tid in [t for t, at in retry_at.items() if at <= now]:
+                    del retry_at[tid]
+                    _dispatch(tid)
                 try:
-                    status, _jid, tid, _idx, payload = my_results.get(timeout=0.25)
+                    status, _jid, tid, idx, payload = my_results.get(timeout=0.25)
                 except _queue.Empty:
                     dead = [
                         i
                         for i, p in enumerate(self._procs)
                         if i not in dead_at_start and not p.is_alive()
                     ]
-                    if dead:
+                    if not dead:
+                        continue
+                    if not retryable:
                         raise TaskError(
                             f"executor(s) {dead} died with tasks in flight "
-                            f"(job {job_id}, {ntasks - done} pending); driver "
+                            f"(job {job_id}, {ntasks - ndone} pending); driver "
                             "scripts must guard entry with if __name__ == '__main__' "
                             "when using the default spawn start method"
                         )
+                    for e in dead:
+                        lost = sorted(t for t, ex in running.items() if ex == e)
+                        self._respawn_executor(e)
+                        dead_at_start.discard(e)
+                        for t in lost:
+                            _schedule_retry(
+                                t, f"executor {e} died while running task {t} "
+                                   "(process loss)")
                     continue
+                if status == "start":
+                    running[tid] = idx
+                    continue
+                if status == "pump_error":
+                    raise ResultPumpError(payload)
+                if done[tid]:
+                    continue  # late duplicate from a superseded attempt
                 if status == "error":
-                    raise TaskError(f"task {tid} failed on executor:\n{payload}")
-                results[tid] = payload
-                done += 1
+                    if max_retries == 0:
+                        failures[tid].append(payload)
+                        _fail_permanently(tid)
+                    _schedule_retry(tid, payload)
+                    continue
+                # status == "ok"; payloads are serialized child-side
+                running.pop(tid, None)
+                if payload is not None:
+                    try:
+                        results[tid] = cloudpickle.loads(payload)
+                    except Exception as e:
+                        raise ResultPumpError(
+                            f"result of task {tid} (job {job_id}) could not "
+                            f"be deserialized: {e!r}") from e
+                done[tid] = True
+                ndone += 1
             return results
         finally:
             with self._job_lock:
@@ -514,7 +702,11 @@ class SparkDataset:
     def map_partitions(self, fn):
         return SparkDataset(self.rdd.mapPartitions(fn))
 
-    def foreach_partition(self, fn, spread=False, placement=None):
+    def foreach_partition(self, fn, spread=False, placement=None,
+                          retryable=False, max_retries=None):
+        # retryable/max_retries are accepted for contract parity; Spark's
+        # own task retry (spark.task.maxFailures) supervises these jobs.
+        del retryable, max_retries
         if spread or placement is not None:
             def _run(it, _fn=fn):
                 _fn(it)

@@ -19,7 +19,7 @@ import threading
 import time
 
 from tensorflowonspark_tpu import marker
-from tensorflowonspark_tpu.utils import telemetry
+from tensorflowonspark_tpu.utils import faults, telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -166,9 +166,10 @@ class DataFeed:
                 break
             try:
                 chunk = self._get_once(timeout_ms=slice_ms, honor_stop=True)
-                break
             except TimeoutError:
                 continue
+            faults.check("feed.get", eof=chunk is None)
+            break
         if t0 is not None:
             # ONE measurement feeds both layers (TrainMetrics.infeed_wait
             # and the telemetry span), so the stall fractions they report

@@ -25,9 +25,74 @@ from __future__ import annotations
 import logging
 import os
 import queue as _queue
+import threading
+import time
 from multiprocessing.managers import BaseManager, DictProxy
 
 logger = logging.getLogger(__name__)
+
+# -- heartbeat liveness ----------------------------------------------------
+# The trainer process beats a wall-clock timestamp into the KV; the feeder
+# (and anything else awaiting the consumer) reads its age to distinguish
+# DEAD from SLOW: a slow trainer keeps beating while it computes, a dead
+# or wedged one goes stale and the waiter can fail fast instead of burning
+# the whole feed_timeout.  Producer and consumer share the host (the
+# manager is loopback), so one wall clock is authoritative.
+
+HEARTBEAT_KEY = "heartbeat"
+
+
+def heartbeat_interval():
+    return float(os.environ.get("TFOS_HEARTBEAT_SECS", "2"))
+
+
+def stale_after():
+    """Age (seconds) past which a heartbeat means 'consumer dead'.  The
+    default tolerates long GIL-held stretches and first-compile stalls;
+    tune down for fast failure detection in tests."""
+    return float(os.environ.get("TFOS_HEARTBEAT_STALE", "60"))
+
+
+def beat(mgr):
+    """Record liveness now (KV write = proof the process schedules)."""
+    mgr.set(HEARTBEAT_KEY, time.time())
+
+
+def heartbeat_age(mgr):
+    """Seconds since the consumer last beat, or None when no beat was
+    ever recorded (or the KV is unreadable) — callers must treat None as
+    'unknown', not 'dead': nodes that predate the first beat and clusters
+    without a heartbeat thread would otherwise be declared lost."""
+    try:
+        v = mgr.get(HEARTBEAT_KEY)
+    except Exception:  # noqa: BLE001 - manager may be tearing down
+        return None
+    if v is None:
+        return None
+    try:
+        return max(0.0, time.time() - float(v))
+    except (TypeError, ValueError):
+        return None
+
+
+def start_heartbeat(mgr, interval=None):
+    """Spawn a daemon thread beating every ``interval`` seconds; returns
+    a stop Event.  Runs in the trainer (node wrapper_fn) for the life of
+    user main_fun."""
+    interval = heartbeat_interval() if interval is None else float(interval)
+    stop = threading.Event()
+
+    def _run():
+        while not stop.is_set():
+            try:
+                beat(mgr)
+            except Exception:  # noqa: BLE001 - manager gone: node exiting
+                return
+            stop.wait(interval)
+
+    t = threading.Thread(target=_run, name="tfos-heartbeat", daemon=True)
+    t.start()
+    return stop
 
 
 class JoinableItemQueue(_queue.Queue):

@@ -35,6 +35,7 @@ import traceback
 from tensorflowonspark_tpu import manager as tfmanager
 from tensorflowonspark_tpu import marker, rendezvous, tpu_info
 from tensorflowonspark_tpu.utils import (
+    faults,
     get_ip_address,
     read_executor_id,
     reap_child,
@@ -56,8 +57,42 @@ class _NodeState:
 
     mgr = None
     cluster_id = None
+    epoch = 0  # cluster incarnation this node belongs to
     ring = None  # shm feed ring (creator side), kept alive for the cluster
     tb_proc = None  # TensorBoard child of the dashboard node
+
+
+def _teardown_node_state():
+    """Dismantle this executor's node incarnation — background trainer,
+    IPC manager, shm ring, TensorBoard — so a retried node task or a new
+    cluster epoch can boot clean on the same executor.  Best-effort
+    throughout: the incarnation being torn down may already be half dead."""
+    mgr = _NodeState.mgr
+    if mgr is not None:
+        try:
+            bg = mgr.get("bg_pid")
+            if bg:
+                reap_child(int(str(bg)), timeout=0.2, term_first=False)
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            mgr.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+    if _NodeState.ring is not None:
+        try:
+            _NodeState.ring.close()
+        except Exception:  # noqa: BLE001
+            pass
+    if _NodeState.tb_proc is not None:
+        try:
+            _NodeState.tb_proc.kill()
+        except Exception:  # noqa: BLE001
+            pass
+    _NodeState.mgr = None
+    _NodeState.cluster_id = None
+    _NodeState.ring = None
+    _NodeState.tb_proc = None
 
 
 def _get_cluster_spec(cluster_info):
@@ -108,6 +143,7 @@ class TFNodeContext:
         working_dir,
         mgr,
         cluster_info=None,
+        epoch=0,
     ):
         self.executor_id = executor_id
         self.job_name = job_name
@@ -117,6 +153,7 @@ class TFNodeContext:
         self.working_dir = working_dir
         self.mgr = mgr
         self.cluster_info = cluster_info or []
+        self.epoch = epoch  # cluster incarnation (bumped by recovery)
 
     @property
     def num_workers(self):
@@ -136,6 +173,22 @@ class TFNodeContext:
         return DataFeed(
             self.mgr, train_mode, qname_in, qname_out, input_mapping, metrics
         )
+
+    def restore_latest(self, ckpt_dir):
+        """(tree, start_step) from the newest checkpoint in ``ckpt_dir``
+        regardless of who wrote it (npz or orbax layouts; (None, 0) when
+        empty) — the auto-resume half of ``cluster.run(restarts=N)``:
+        training mains call this at startup, so a relaunched incarnation
+        continues from where the dead one last saved."""
+        from tensorflowonspark_tpu.utils import checkpoint as _ckpt
+
+        tree, step = _ckpt.restore_any(ckpt_dir)
+        telemetry.event("node/resume", step=step, epoch=self.epoch,
+                        found=tree is not None)
+        if tree is not None:
+            logger.info("node %s:%s resuming from step %d (epoch %d)",
+                        self.job_name, self.task_index, step, self.epoch)
+        return tree, step
 
     def distributed_env(self):
         env = _distributed_env(self.cluster_info)
@@ -296,131 +349,170 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
                 spool=os.path.abspath(".tfos_telemetry"),
             )
 
-        # (3) idempotency/retry guard (TFSparkNode.py:249-255): a live
-        # manager from the SAME cluster means a duplicate placement — raise
-        # so the engine/Spark retries this task elsewhere.
-        if (
-            _NodeState.mgr is not None
-            and _NodeState.cluster_id == cluster_meta["id"]
-            and str(_NodeState.mgr.get("state")) in ("running", "terminating")
-        ):
-            raise RuntimeError(
-                f"executor already hosts a node of cluster {cluster_meta['id']}"
-            )
+        faults.check("node.boot", executor=executor_id, job=job_name)
+
+        # (3) idempotency/retry guard (TFSparkNode.py:249-255), epoch-aware:
+        # a live manager from the SAME cluster AND epoch means a duplicate
+        # placement — raise so the engine/Spark retries this task elsewhere.
+        # A node from a PREVIOUS epoch (cluster recovery relaunched us on a
+        # surviving executor) is stale: tear it down and boot fresh.
+        epoch = int(cluster_meta.get("epoch", 0))
+        if (_NodeState.mgr is not None
+                and _NodeState.cluster_id == cluster_meta["id"]):
+            try:
+                state = str(_NodeState.mgr.get("state"))
+            except Exception:  # noqa: BLE001 - manager server already dead
+                state = None
+            if (_NodeState.epoch == epoch
+                    and state in ("running", "terminating")):
+                raise RuntimeError(
+                    f"executor already hosts a node of cluster "
+                    f"{cluster_meta['id']}"
+                )
+            logger.info(
+                "tearing down stale node incarnation (epoch %d state %s) "
+                "before booting epoch %d", _NodeState.epoch, state, epoch)
+            _teardown_node_state()
 
         authkey = bytes.fromhex(cluster_meta["authkey"])
         mode = "remote" if job_name in ("ps", "evaluator") else "local"
         mgr = tfmanager.start(authkey, queues, mode)
         _NodeState.mgr = mgr
         _NodeState.cluster_id = cluster_meta["id"]
+        _NodeState.epoch = epoch
         write_executor_id(executor_id)
 
-        # Fast same-host feed transport: a shared-memory ring for the
-        # 'input' stream (native/shmqueue.cpp).  The manager keeps
-        # control/error/output and the state machine; the ring carries the
-        # bulk record chunks with no per-chunk manager RPC.
-        if os.environ.get("TFOS_SHM_FEED", "1") != "0":
-            try:
-                from tensorflowonspark_tpu.recordio import shm as shmq
+        # Everything up to execution is boot: a failure here (rendezvous
+        # rejection, injected fault, dead ring) must release this
+        # executor's node identity — manager, ring, children — so an
+        # engine-level retry of the SAME task can boot clean instead of
+        # tripping the duplicate-placement guard forever.
+        try:
+            # Fast same-host feed transport: a shared-memory ring for the
+            # 'input' stream (native/shmqueue.cpp).  The manager keeps
+            # control/error/output and the state machine; the ring carries
+            # the bulk record chunks with no per-chunk manager RPC.
+            if os.environ.get("TFOS_SHM_FEED", "1") != "0":
+                try:
+                    from tensorflowonspark_tpu.recordio import shm as shmq
 
-                if shmq.available():
-                    ring_name = f"/tfos-{cluster_meta['id'] & 0xffffffff:x}-{executor_id}"
-                    cap = int(os.environ.get("TFOS_SHM_FEED_BYTES", str(256 << 20)))
-                    _NodeState.ring = shmq.ShmQueue(ring_name, cap, create=True)
-                    mgr.set("shm_input", ring_name)
-            except Exception as e:  # noqa: BLE001 - optional acceleration
-                logger.warning("shm feed unavailable: %s", e)
+                    if shmq.available():
+                        # epoch in the name: a recovered cluster's fresh ring
+                        # must never collide with a dead incarnation's shm
+                        # segment that a wedged orphan still maps
+                        ring_name = (
+                            f"/tfos-{cluster_meta['id'] & 0xffffffff:x}"
+                            f"{'' if not epoch else f'-e{epoch}'}"
+                            f"-{executor_id}")
+                        cap = int(os.environ.get("TFOS_SHM_FEED_BYTES", str(256 << 20)))
+                        _NodeState.ring = shmq.ShmQueue(ring_name, cap, create=True)
+                        mgr.set("shm_input", ring_name)
+                except Exception as e:  # noqa: BLE001 - optional acceleration
+                    logger.warning("shm feed unavailable: %s", e)
 
-        # (4) rendezvous: reserve a port for the coordinator service (the
-        # free-port trick, TFSparkNode.py:337-342), then register.
-        client = rendezvous.Client(cluster_meta["server_addr"])
-        host = get_ip_address()
-        tmp_sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        tmp_sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        port_env = os.environ.get("TFOS_NODE_PORT")
-        tmp_sock.bind(("", int(port_env) if port_env else 0))
-        port = tmp_sock.getsockname()[1]
-        maddr = list(mgr.address)
-        if mode == "remote" and maddr[0] in ("", "0.0.0.0"):
-            maddr[0] = host  # advertise a dialable address to the driver
-        node_meta = {
-            "executor_id": executor_id,
-            "host": host,
-            "job_name": job_name,
-            "task_index": task_index,
-            "port": port,
-            "addr": maddr,
-            "authkey": cluster_meta["authkey"],
-        }
+            # (4) rendezvous: reserve a port for the coordinator service (the
+            # free-port trick, TFSparkNode.py:337-342), then register.
+            client = rendezvous.Client(cluster_meta["server_addr"])
+            host = get_ip_address()
+            tmp_sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            tmp_sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            port_env = os.environ.get("TFOS_NODE_PORT")
+            tmp_sock.bind(("", int(port_env) if port_env else 0))
+            port = tmp_sock.getsockname()[1]
+            maddr = list(mgr.address)
+            if mode == "remote" and maddr[0] in ("", "0.0.0.0"):
+                maddr[0] = host  # advertise a dialable address to the driver
+            node_meta = {
+                "executor_id": executor_id,
+                "host": host,
+                "job_name": job_name,
+                "task_index": task_index,
+                "port": port,
+                "addr": maddr,
+                "authkey": cluster_meta["authkey"],
+            }
 
-        # dashboard node: spawn TensorBoard before registering so its port
-        # travels with the reservation (TFSparkNode.py:282-319)
-        if (
-            tensorboard
-            and task_index == 0
-            and job_name in ("chief", "master", "worker")
-            and ("chief" not in cluster_meta["cluster_template"]
-                 and "master" not in cluster_meta["cluster_template"]
-                 or job_name in ("chief", "master"))
-        ):
-            from tensorflowonspark_tpu.utils import profiler as _profiler
+            # dashboard node: spawn TensorBoard before registering so its
+            # port travels with the reservation (TFSparkNode.py:282-319)
+            if (
+                tensorboard
+                and task_index == 0
+                and job_name in ("chief", "master", "worker")
+                and ("chief" not in cluster_meta["cluster_template"]
+                     and "master" not in cluster_meta["cluster_template"]
+                     or job_name in ("chief", "master"))
+            ):
+                from tensorflowonspark_tpu.utils import profiler as _profiler
 
-            tb_dir = log_dir or os.path.join(
-                cluster_meta["working_dir"], "tensorboard",
-                f"cluster-{cluster_meta['id'] & 0xffffffff:x}",
+                tb_dir = log_dir or os.path.join(
+                    cluster_meta["working_dir"], "tensorboard",
+                    f"cluster-{cluster_meta['id'] & 0xffffffff:x}",
+                )
+                _NodeState.tb_proc, tb_port = _profiler.launch_tensorboard(tb_dir)
+                if tb_port:
+                    node_meta["tb_port"] = tb_port
+                    # pid in the manager KV so the shutdown closure (which
+                    # may run in a different python worker) can kill the
+                    # child
+                    mgr.set("tb_pid", _NodeState.tb_proc.pid)
+                    telemetry.event("node/tb_spawn", port=tb_port,
+                                    pid=_NodeState.tb_proc.pid)
+
+            client.register(node_meta, epoch=epoch)
+            cluster_info = client.await_reservations(
+                timeout=cluster_meta.get("reservation_timeout", 600)
             )
-            _NodeState.tb_proc, tb_port = _profiler.launch_tensorboard(tb_dir)
-            if tb_port:
-                node_meta["tb_port"] = tb_port
-                # pid in the manager KV so the shutdown closure (which may
-                # run in a different python worker) can kill the child
-                mgr.set("tb_pid", _NodeState.tb_proc.pid)
-                telemetry.event("node/tb_spawn", port=tb_port,
-                                pid=_NodeState.tb_proc.pid)
+            client.close()
+            logger.info("node %d: cluster complete (%d nodes)", executor_id, len(cluster_info))
 
-        client.register(node_meta)
-        cluster_info = client.await_reservations(
-            timeout=cluster_meta.get("reservation_timeout", 600)
-        )
-        client.close()
-        logger.info("node %d: cluster complete (%d nodes)", executor_id, len(cluster_info))
+            # (5) context + bootstrap env
+            cluster_spec = _get_cluster_spec(cluster_info)
+            ctx = TFNodeContext(
+                executor_id,
+                job_name,
+                task_index,
+                cluster_spec,
+                cluster_meta["default_fs"],
+                cluster_meta["working_dir"],
+                mgr,
+                cluster_info,
+                epoch=epoch,
+            )
+            ctx.export_env()
 
-        # (5) context + bootstrap env
-        cluster_spec = _get_cluster_spec(cluster_info)
-        ctx = TFNodeContext(
-            executor_id,
-            job_name,
-            task_index,
-            cluster_spec,
-            cluster_meta["default_fs"],
-            cluster_meta["working_dir"],
-            mgr,
-            cluster_info,
-        )
-        ctx.export_env()
+            # release the reserved port as late as possible
+            tmp_sock.close()
 
-        # release the reserved port as late as possible
-        tmp_sock.close()
-
-        # Boot complete: chips claimed, manager up, rendezvous done.  The
-        # spool dir is advertised in the manager KV so the driver drain
-        # (cluster.shutdown -> drain_telemetry) can find every node file.
-        telemetry.register_with(mgr)
-        telemetry.record_span(
-            "node/boot", time.perf_counter() - boot_t0,
-            executor=executor_id, nodes=len(cluster_info))
+            # Boot complete: chips claimed, manager up, rendezvous done.
+            # The spool dir is advertised in the manager KV so the driver
+            # drain (cluster.shutdown -> drain_telemetry) can find every
+            # node file.
+            telemetry.register_with(mgr)
+            telemetry.record_span(
+                "node/boot", time.perf_counter() - boot_t0,
+                executor=executor_id, nodes=len(cluster_info))
+        except BaseException:
+            telemetry.flush()
+            _teardown_node_state()
+            raise
 
         def wrapper_fn(args, context):
             if isinstance(args, list):
                 sys.argv = args
+            # liveness beacon for the feeder: a trainer that stops beating
+            # is DEAD, one that beats while busy is merely SLOW
+            hb = tfmanager.start_heartbeat(mgr)
             try:
                 with telemetry.span("node/main", job=context.job_name,
                                     task=context.task_index):
+                    faults.check("node.main", job=context.job_name,
+                                 task=context.task_index)
                     fn(args, context)
                 # all processes leave together (see sync_exit_barrier
                 # docstring)
                 context.sync_exit_barrier()
             finally:
+                hb.set()
                 telemetry.flush()
 
         def wrapper_fn_background(args, context):
@@ -511,17 +603,36 @@ def _open_feed_ring(mgr, qname):
     return open_feed_ring(mgr, qname, producer=True)
 
 
+def _raise_if_consumer_lost(mgr, equeue):
+    """Fail the feeder fast when the consumer errored or died.
+
+    The error queue is PEEKED — get, then put back — so an engine/Spark
+    retry of this feeder task still observes a persistent worker failure
+    (a consuming read would make the retry hang on an empty queue until
+    feed_timeout).  Heartbeat age (manager.py) distinguishes DEAD from
+    SLOW: a busy trainer keeps beating, a killed one goes stale; no beat
+    ever recorded means 'unknown', never 'dead'."""
+    if not equeue.empty():
+        e_str = equeue.get()
+        equeue.task_done()
+        equeue.put(e_str)
+        raise RuntimeError(f"exception in worker:\n{e_str}")
+    age = tfmanager.heartbeat_age(mgr)
+    if age is not None and age > tfmanager.stale_after():
+        raise RuntimeError(
+            f"consumer appears dead: no heartbeat for {age:.0f}s "
+            f"(stale after {tfmanager.stale_after():.0f}s, "
+            f"TFOS_HEARTBEAT_STALE)")
+
+
 def _await_consumption(mgr, waiter, feed_timeout, poll=1.0):
     """Wait for the consumer to drain what we queued, polling the error
-    queue (parity: TFSparkNode.py:484-497).  ``waiter()`` returns True
-    while data is still outstanding."""
+    queue and the consumer heartbeat (parity: TFSparkNode.py:484-497).
+    ``waiter()`` returns True while data is still outstanding."""
     equeue = mgr.get_queue("error")
     timeout = feed_timeout
     while waiter():
-        if not equeue.empty():
-            e_str = equeue.get()
-            equeue.task_done()
-            raise RuntimeError(f"exception in worker:\n{e_str}")
+        _raise_if_consumer_lost(mgr, equeue)
         time.sleep(poll)
         timeout -= poll
         if timeout <= 0:
@@ -597,11 +708,44 @@ def _make_chunk_encoder():
     return encode
 
 
-def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
+def _partition_index():
+    """This feed task's partition id: Spark TaskContext under real
+    pyspark, else the engine-exported TFOS_PARTITION_INDEX; -1 when
+    neither is known (feed-consumption accounting is then disabled)."""
+    try:
+        from pyspark import TaskContext
+
+        tc = TaskContext.get()
+        if tc is not None:
+            return int(tc.partitionId())
+    except Exception:  # noqa: BLE001 - no spark on this path
+        pass
+    try:
+        return int(os.environ.get("TFOS_PARTITION_INDEX", "-1"))
+    except (TypeError, ValueError):
+        return -1
+
+
+def train(cluster_info, cluster_meta, feed_timeout=600, qname="input",
+          skip=None):
     """Feeder closure: push partition records as chunks over the shm ring
-    (fast path) or the manager queue (parity: TFSparkNode.train :448-515)."""
+    (fast path) or the manager queue (parity: TFSparkNode.train :448-515).
+
+    ``skip`` is a set of partition indices already fully consumed in a
+    previous cluster incarnation (rendezvous feed ledger): a relaunched
+    feed job drains those partitions without re-feeding, so auto-resumed
+    training never sees the same record twice."""
+    skip = frozenset(skip or ())
 
     def _train(iterator):
+        pidx = _partition_index()
+        if pidx >= 0 and pidx in skip:
+            count = sum(1 for _ in iterator)
+            logger.info("feeder: partition %d already consumed before "
+                        "recovery, skipping %d records", pidx, count)
+            telemetry.event("feed/partition_skipped", part=pidx,
+                            records=count)
+            return
         mgr = _get_manager(cluster_info, get_ip_address(), read_executor_id())
         telemetry.register_with(mgr)
         state = str(mgr.get("state"))
@@ -612,13 +756,16 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
             return
         ring = _open_feed_ring(mgr, qname)
         queue = None if ring is not None else mgr.get_queue(qname)
+        equeue = mgr.get_queue("error")
         encode = _make_chunk_encoder()
 
         def put(chunk):
             """False once the consumer requested termination mid-feed: a
             put blocked on a full ring re-checks state each second, so a
             feeder never deadlocks against a consumer that stopped
-            draining."""
+            draining (and fails fast when the consumer errored or its
+            heartbeat went stale)."""
+            faults.check("feed.put", part=pidx)
             chunk = encode(chunk)
             if ring is not None:
                 while True:
@@ -628,6 +775,7 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
                     except TimeoutError:
                         if str(mgr.get("state")) == "terminating":
                             return False
+                        _raise_if_consumer_lost(mgr, equeue)
             else:
                 queue.put(chunk, block=True)
                 return True
@@ -660,7 +808,7 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
                         discarded + len(chunk))
         logger.info("feeder: queued %d records (%s path)", total,
                     "shm" if ring is not None else "manager")
-        telemetry.event("feed/partition_queued", records=total,
+        telemetry.event("feed/partition_queued", part=pidx, records=total,
                         path="shm" if ring is not None else "manager",
                         terminated=terminated)
 
@@ -676,6 +824,20 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
             joining = threading.Thread(target=queue.join, daemon=True)
             joining.start()
             _await_consumption(mgr, joining.is_alive, feed_timeout)
+
+        # fully consumed, not cut short: record it in the driver's feed
+        # ledger so a post-recovery relaunch of this feed job skips it.
+        # Best-effort — standalone tests feed against a placeholder
+        # server_addr with no rendezvous listening.
+        if not terminated and pidx >= 0:
+            try:
+                client = rendezvous.Client(cluster_meta["server_addr"])
+                client.partition_done(qname, pidx)
+                client.close()
+            except Exception as e:  # noqa: BLE001 - accounting only
+                logger.warning(
+                    "feeder: could not record partition %d consumed: %s",
+                    pidx, e)
 
         if str(mgr.get("state")) == "terminating":
             logger.info("feeder: consumer requested termination")

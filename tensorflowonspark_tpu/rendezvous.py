@@ -23,13 +23,14 @@ from __future__ import annotations
 import json
 import logging
 import os
+import random
 import select
 import socket
 import struct
 import threading
 import time
 
-from tensorflowonspark_tpu.utils import telemetry
+from tensorflowonspark_tpu.utils import faults, telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -69,7 +70,22 @@ class Reservations:
 
     def add(self, meta):
         with self._lock:
+            eid = meta.get("executor_id") if isinstance(meta, dict) else None
+            if eid is not None:
+                for i, m in enumerate(self._reservations):
+                    if isinstance(m, dict) and m.get("executor_id") == eid:
+                        # a respawned node re-registering within the epoch
+                        # replaces its stale reservation instead of
+                        # corrupting the frozen spec with a duplicate
+                        logger.info(
+                            "replacing reservation of executor %s", eid)
+                        self._reservations[i] = meta
+                        return
             self._reservations.append(meta)
+
+    def reset(self):
+        with self._lock:
+            self._reservations = []
 
     def done(self):
         with self._lock:
@@ -136,6 +152,38 @@ class Server(MessageSocket):
         self._closing = threading.Event()
         self._listener = None
         self._thread = None
+        # Epoch fence: cluster.run(restarts=N) recovery bumps this via
+        # reset(); REG messages stamped with an older epoch are rejected,
+        # so a node task from the previous incarnation (e.g. an engine
+        # retry racing the relaunch) can never pollute the new spec.
+        self.epoch = 0
+        # Feed-replay ledger: feeders report fully-consumed partitions
+        # (PDONE) per feed qname; after a recovery the driver re-feeds
+        # only what is NOT in the ledger.
+        self._feeds = {}
+        self._feed_lock = threading.Lock()
+
+    def reset(self, epoch):
+        """Fence a new cluster incarnation: drop all reservations and the
+        STOP flag, and reject REG messages from older epochs from now on.
+        The feed ledger deliberately survives (it is what makes re-feeding
+        skip already-consumed partitions)."""
+        self.epoch = int(epoch)
+        self.reservations.reset()
+        self.done.clear()
+        telemetry.event("rendezvous/epoch_reset", epoch=self.epoch)
+        logger.info("rendezvous: reset to epoch %d", self.epoch)
+
+    def fed_partitions(self, feed="input"):
+        """Sorted partition indices recorded as fully consumed for ``feed``."""
+        with self._feed_lock:
+            return sorted(self._feeds.get(str(feed), ()))
+
+    def reset_feed(self, feed="input"):
+        """Clear the consumption ledger for ``feed`` (start of a train
+        call: each train() owns one replay scope)."""
+        with self._feed_lock:
+            self._feeds.pop(str(feed), None)
 
     def start(self):
         """Bind, spawn the select() loop thread, return (host, port)."""
@@ -198,11 +246,32 @@ class Server(MessageSocket):
                 pass
 
     def _handle_message(self, sock, msg):
-        """REG/QUERY/QINFO/QNUM/STOP (parity: reservation.py:130-146)."""
+        """REG/QUERY/QINFO/QNUM/PDONE/PQUERY/STOP
+        (parity: reservation.py:130-146; PDONE/PQUERY and the epoch stamp
+        are fault-tolerance extensions)."""
         kind = msg.get("type")
         if kind == "REG":
+            epoch = int(msg.get("epoch", 0))
+            if epoch != self.epoch:
+                logger.warning(
+                    "rejecting registration from epoch %d (current %d): %s",
+                    epoch, self.epoch, msg.get("data"))
+                self.send(sock, {"type": "REJECT",
+                                 "data": {"epoch": self.epoch}})
+                return
             self.reservations.add(msg["data"])
             self.send(sock, {"type": "OK"})
+        elif kind == "PDONE":
+            with self._feed_lock:
+                self._feeds.setdefault(
+                    str(msg.get("feed", "input")), set()
+                ).add(int(msg["part"]))
+            self.send(sock, {"type": "OK"})
+        elif kind == "PQUERY":
+            self.send(sock, {
+                "type": "PQUERY",
+                "data": self.fed_partitions(msg.get("feed", "input")),
+            })
         elif kind == "QUERY":
             self.send(sock, {"type": "QUERY", "data": self.reservations.done()})
         elif kind == "QINFO":
@@ -265,35 +334,87 @@ class Client(MessageSocket):
             f"cannot reach rendezvous server at {self.server_addr}: {last}"
         )
 
+    # Pure queries may be replayed on a fresh connection with no
+    # server-side effect; REG/STOP/PDONE mutate state and must not be.
+    IDEMPOTENT = frozenset({"QUERY", "QINFO", "QNUM", "PQUERY"})
+
     def _call(self, msg):
-        self.send(self._sock, msg)
-        reply = self.receive(self._sock)
+        err = None
+        try:
+            self.send(self._sock, msg)
+            reply = self.receive(self._sock)
+        except OSError as e:
+            reply, err = None, e
+        if reply is not None:
+            return reply
+        if msg.get("type") not in self.IDEMPOTENT:
+            raise ConnectionError("rendezvous server closed connection"
+                                  + (f" ({err})" if err else ""))
+        # one transparent reconnect+replay: a dropped connection under a
+        # pure query (driver restarted select loop, transient RST) should
+        # not kill a node that is merely polling
+        logger.warning("rendezvous connection lost during %s; reconnecting",
+                       msg.get("type"))
+        self.close()
+        self._sock = self._connect()
+        try:
+            self.send(self._sock, msg)
+            reply = self.receive(self._sock)
+        except OSError as e:
+            raise ConnectionError(
+                "rendezvous server closed connection") from e
         if reply is None:
             raise ConnectionError("rendezvous server closed connection")
         return reply
 
-    def register(self, node_meta):
+    def register(self, node_meta, epoch=0):
+        """Register this node, stamped with its cluster epoch.  A REJECT
+        (stale epoch: the cluster recovered past this node's incarnation)
+        raises — the hosting task must die so the engine can retry with
+        fresh cluster metadata, or give up."""
         with telemetry.span(
                 "rendezvous/register",
                 job=node_meta.get("job_name") if isinstance(node_meta, dict)
                 else None,
                 task=node_meta.get("task_index") if isinstance(node_meta, dict)
-                else None):
-            return self._call({"type": "REG", "data": node_meta})
+                else None,
+                epoch=epoch):
+            faults.check("rendezvous.register")
+            reply = self._call(
+                {"type": "REG", "data": node_meta, "epoch": int(epoch)})
+            if reply.get("type") == "REJECT":
+                raise RuntimeError(
+                    f"rendezvous registration rejected: node epoch {epoch} "
+                    f"!= cluster epoch {reply['data']['epoch']} (stale node "
+                    "from a previous cluster incarnation)")
+            return reply
 
     def get_reservations(self):
         return self._call({"type": "QINFO"})["data"]
+
+    def partition_done(self, feed, part):
+        """Record partition ``part`` of ``feed`` as fully consumed."""
+        return self._call({"type": "PDONE", "feed": str(feed),
+                           "part": int(part)})
+
+    def fed_partitions(self, feed="input"):
+        return self._call({"type": "PQUERY", "feed": str(feed)})["data"]
 
     def await_reservations(self, timeout=DEFAULT_TIMEOUT):
         """Poll until the cluster is complete, then return all node metas."""
         with telemetry.span("rendezvous/await_cluster_spec") as sp:
             deadline = time.time() + timeout
             polls = 0
-            while not self._call({"type": "QUERY"})["data"]:
+            while True:
+                faults.check("rendezvous.query")
+                if self._call({"type": "QUERY"})["data"]:
+                    break
                 polls += 1
                 if time.time() > deadline:
                     raise TimeoutError("timed out awaiting cluster completion")
-                time.sleep(POLL_SECS)
+                # jittered poll: N nodes registering together must not hit
+                # the server's select loop in lockstep every POLL_SECS
+                time.sleep(POLL_SECS * (0.5 + random.random()))
             sp.add(polls=polls)
             return self.get_reservations()
 

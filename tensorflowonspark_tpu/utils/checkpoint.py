@@ -22,7 +22,7 @@ import os
 import numpy as np
 
 from tensorflowonspark_tpu.recordio import fs as _fs
-from tensorflowonspark_tpu.utils import telemetry
+from tensorflowonspark_tpu.utils import faults, telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -52,6 +52,7 @@ def save_checkpoint(ckpt_dir, params, step, keep=3):
     """Write step-stamped npz checkpoint to any filesystem (local,
     gs://, hdfs://, ... via fsspec); prune old ones."""
     with telemetry.span("checkpoint/save", step=step):
+        faults.check("checkpoint.save", step=step)
         _fs.makedirs(ckpt_dir)
         flat = _flatten(_to_host(params))
         path = _fs.join(ckpt_dir, f"ckpt-{step:08d}.npz")
@@ -180,6 +181,55 @@ def restore_latest(ckpt_dir):
     return load_checkpoint(path), step_of(path)
 
 
+def _steps_by_format(ckpt_dir):
+    """{'npz': [steps...], 'orbax': [steps...]} found in ``ckpt_dir``.
+
+    npz checkpoints are ``ckpt-<step>.npz`` files; orbax CheckpointManager
+    step dirs are all-digit directory names.  Listing is format-blind so
+    auto-resume works whichever writer the dead incarnation used."""
+    out = {"npz": [], "orbax": []}
+    if not _fs.isdir(ckpt_dir):
+        return out
+    for name in _fs.listdir(ckpt_dir):
+        name = name.rstrip("/")
+        if name.startswith("ckpt-") and name.endswith(".npz"):
+            try:
+                out["npz"].append(step_of(name))
+            except ValueError:
+                pass
+        elif name.isdigit():
+            out["orbax"].append(int(name))
+    return out
+
+
+def latest_step(ckpt_dir):
+    """Newest checkpoint step in ``ckpt_dir`` across BOTH formats (npz
+    and orbax), or None when the dir is absent/empty."""
+    steps = _steps_by_format(ckpt_dir)
+    every = steps["npz"] + steps["orbax"]
+    return max(every) if every else None
+
+
+def restore_any(ckpt_dir):
+    """(tree, step) from the newest checkpoint regardless of format, or
+    (None, 0).  The auto-resume entry point (``TFNodeContext
+    .restore_latest``): a relaunched node must continue from whatever its
+    dead predecessor last published, whether it saved via
+    ``save_checkpoint`` (npz) or :class:`AsyncCheckpointer` (orbax)."""
+    steps = _steps_by_format(ckpt_dir)
+    best_npz = max(steps["npz"]) if steps["npz"] else -1
+    best_orbax = max(steps["orbax"]) if steps["orbax"] else -1
+    if best_orbax < 0 and best_npz < 0:
+        return None, 0
+    if best_orbax >= best_npz:
+        ckpt = AsyncCheckpointer(ckpt_dir)
+        try:
+            return ckpt.restore_latest()
+        finally:
+            ckpt.close()
+    return restore_latest(ckpt_dir)
+
+
 class AsyncCheckpointer:
     """Orbax-backed async checkpointing (GCS-capable) behind the same
     save/restore contract as the npz functions: device-to-host copy and
@@ -214,6 +264,8 @@ class AsyncCheckpointer:
     def save(self, step, tree):
         """Queue an async save of ``tree`` at ``step`` (non-blocking)."""
         import jax
+
+        faults.check("checkpoint.save", step=step)
 
         # orbax's StandardSave rejects numpy scalar leaves (np.float32);
         # promote them to 0-d arrays, which round-trip identically

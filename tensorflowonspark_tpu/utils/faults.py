@@ -1,0 +1,246 @@
+"""Deterministic fault injection for the fault-tolerant runtime.
+
+Parity intent: the reference has NO injection layer — its failure tests
+kill Spark executors from the outside (test/test_TFCluster.py relies on
+task retries).  Here failures are first-class: the supervision stack
+(engine retry/respawn, cluster.run(restarts=N) recovery, heartbeat
+liveness) is only trustworthy if every failure mode can be reproduced
+deterministically, so the injection points live in the production code
+paths and are driven entirely by environment variables — which makes
+them *spawn-safe*: executor processes and their fork children inherit
+the plan with no extra plumbing.
+
+Plan grammar (``TFOS_FAULT_PLAN``)::
+
+    plan  := entry ("," entry)*
+    entry := site ":" kind ["(" arg ")"] ["@" hits]
+    kind  := "exc" | "kill" | "hang" | "delay"
+    hits  := N      -- fire on exactly the N-th check of this site (1-based)
+           | N "+"  -- fire on the N-th and every later check
+           | "*"    -- fire on every check
+
+``hits`` defaults to ``1``.  Kinds:
+
+- ``exc``          raise :class:`FaultInjected`
+- ``kill``         ``SIGKILL`` the calling process (an un-catchable crash,
+                   the executor-loss case)
+- ``hang(secs)``   sleep (default 3600s — "forever" at test scale); models
+                   a wedged node that only heartbeat staleness can detect
+- ``delay(secs)``  sleep briefly (default 1s) then continue; models slow,
+                   not dead
+
+Hit counters are **per process, per site**: a respawned executor or a
+relaunched trainer starts from zero, which is exactly the semantics a
+retry/restart test needs ("fail the first boot, succeed the second").
+
+Scoping: ``TFOS_FAULT_EXECUTOR=<n>`` restricts firing to processes whose
+``TFOS_EXECUTOR_INDEX`` equals ``n`` (fork children inherit the index),
+so a plan can target one executor of a pool deterministically.
+
+Every fault that fires emits a ``fault/injected`` telemetry event (and
+flushes, so even a ``kill`` leaves its event on disk).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random as _random
+import signal
+import time
+
+from tensorflowonspark_tpu.utils import telemetry
+
+logger = logging.getLogger(__name__)
+
+PLAN_ENV = "TFOS_FAULT_PLAN"
+EXECUTOR_ENV = "TFOS_FAULT_EXECUTOR"
+
+KINDS = ("exc", "kill", "hang", "delay")
+
+#: Injection points wired into the runtime (site -> where it fires).
+SITES = (
+    "engine.task",          # engine.py executor loop, before running a task
+    "node.boot",            # node.py _mapfn, before the manager starts
+    "node.main",            # node.py wrapper_fn, before user main_fun
+    "feed.put",             # node.py feeder, before each chunk put
+    "feed.get",             # feed.py DataFeed, after each chunk pop
+    "rendezvous.register",  # rendezvous.py Client.register
+    "rendezvous.query",     # rendezvous.py Client.await_reservations polls
+    "checkpoint.save",      # utils/checkpoint.py save paths
+)
+
+#: Sites whose hit counters live in long-lived executor processes, so a
+#: consumed occurrence stays consumed across engine retries — safe for
+#: randomized chaos runs that must eventually make progress.  Trainer-side
+#: sites (feed.get, node.main, checkpoint.save) restart their counters in
+#: every relaunched fork child and would re-fire forever.
+CHAOS_SITES = ("engine.task", "node.boot", "feed.put", "rendezvous.query")
+
+
+class FaultInjected(RuntimeError):
+    """An exception raised by an injected ``exc`` fault."""
+
+
+class _Fault:
+    __slots__ = ("site", "kind", "arg", "first", "last")
+
+    def __init__(self, site, kind, arg, first, last):
+        self.site = site
+        self.kind = kind
+        self.arg = arg
+        self.first = first  # 1-based hit the fault starts firing on
+        self.last = last    # last firing hit (None = open-ended)
+
+    def matches(self, hit):
+        if hit < self.first:
+            return False
+        return self.last is None or hit <= self.last
+
+    def __repr__(self):
+        hits = ("*" if (self.first, self.last) == (1, None)
+                else f"{self.first}+" if self.last is None
+                else str(self.first))
+        arg = f"({self.arg:g})" if self.arg is not None else ""
+        return f"{self.site}:{self.kind}{arg}@{hits}"
+
+
+def parse_plan(plan):
+    """``TFOS_FAULT_PLAN`` string -> list of :class:`_Fault`.
+
+    Raises ``ValueError`` on malformed entries — a typo'd plan must fail
+    loudly, not silently inject nothing.
+    """
+    faults = []
+    for raw in str(plan or "").split(","):
+        entry = raw.strip()
+        if not entry:
+            continue
+        site, sep, rest = entry.partition(":")
+        site = site.strip()
+        if not sep or not site:
+            raise ValueError(f"fault entry {entry!r}: expected site:kind")
+        if site not in SITES:
+            raise ValueError(
+                f"fault entry {entry!r}: unknown site {site!r} "
+                f"(valid: {', '.join(SITES)})")
+        rest, _, hits_s = rest.partition("@")
+        kind, arg = rest.strip(), None
+        if "(" in kind:
+            if not kind.endswith(")"):
+                raise ValueError(f"fault entry {entry!r}: unclosed arg")
+            kind, arg_s = kind[:-1].split("(", 1)
+            try:
+                arg = float(arg_s)
+            except ValueError:
+                raise ValueError(
+                    f"fault entry {entry!r}: non-numeric arg {arg_s!r}"
+                ) from None
+        if kind not in KINDS:
+            raise ValueError(
+                f"fault entry {entry!r}: unknown kind {kind!r} "
+                f"(valid: {', '.join(KINDS)})")
+        hits_s = hits_s.strip() or "1"
+        if hits_s == "*":
+            first, last = 1, None
+        elif hits_s.endswith("+"):
+            first, last = int(hits_s[:-1]), None
+        else:
+            first = int(hits_s)
+            last = first
+        if first < 1:
+            raise ValueError(f"fault entry {entry!r}: hits are 1-based")
+        faults.append(_Fault(site, kind, arg, first, last))
+    return faults
+
+
+# Per-process parse cache + hit counters.  Keyed by pid: a fork child
+# inherits the parent's dict but must count its own hits from zero.
+_state = {"pid": None, "plan": None, "faults": (), "hits": {}}
+
+
+def _faults_for_this_process():
+    plan = os.environ.get(PLAN_ENV, "")
+    if _state["pid"] != os.getpid() or _state["plan"] != plan:
+        _state["pid"] = os.getpid()
+        _state["plan"] = plan
+        _state["hits"] = {}
+        try:
+            _state["faults"] = tuple(parse_plan(plan))
+        except ValueError:
+            logger.exception("invalid %s=%r; injecting nothing", PLAN_ENV, plan)
+            _state["faults"] = ()
+    return _state["faults"]
+
+
+def _scoped_out():
+    """True when TFOS_FAULT_EXECUTOR is set and this process (or its
+    executor ancestor) is a different executor."""
+    want = os.environ.get(EXECUTOR_ENV, "").strip()
+    if not want:
+        return False
+    return os.environ.get("TFOS_EXECUTOR_INDEX", "").strip() != want
+
+
+def check(site, **attrs):
+    """Injection point: count a hit on ``site`` and fire any planned fault.
+
+    Free when no plan is set (one env read + dict lookup).  Call it at
+    the top of the operation it guards; ``attrs`` travel into the
+    ``fault/injected`` telemetry event for the recovery timeline.
+    """
+    faults = _faults_for_this_process()
+    if not faults:
+        return
+    armed = [f for f in faults if f.site == site]
+    if not armed or _scoped_out():
+        return
+    hit = _state["hits"].get(site, 0) + 1
+    _state["hits"][site] = hit
+    for f in armed:
+        if not f.matches(hit):
+            continue
+        logger.warning("fault injection: %r firing at hit %d of %s (pid %d)",
+                       f, hit, site, os.getpid())
+        telemetry.event("fault/injected", site=site, kind=f.kind, hit=hit,
+                        pid=os.getpid(), **attrs)
+        # a kill/hang never returns: the event must already be on disk
+        telemetry.flush()
+        if f.kind == "exc":
+            raise FaultInjected(
+                f"injected fault at {site} (hit {hit}, plan {f!r})")
+        if f.kind == "kill":
+            os.kill(os.getpid(), signal.SIGKILL)
+            time.sleep(60)  # pending-signal window; never reached
+        if f.kind == "hang":
+            time.sleep(3600.0 if f.arg is None else f.arg)
+            raise FaultInjected(
+                f"injected hang at {site} expired (hit {hit}, plan {f!r})")
+        if f.kind == "delay":
+            time.sleep(1.0 if f.arg is None else f.arg)
+        return
+
+
+def random_plan(seed, max_faults=2, sites=CHAOS_SITES):
+    """A reproducible chaos plan: same seed, same plan, always parseable.
+
+    Restricted to :data:`CHAOS_SITES` by default (see its docstring) and
+    to ``exc`` faults — ``kill``/``hang`` scenarios are exercised by the
+    deterministic tests; the chaos smoke's job is breadth under the
+    retry/restart machinery, and it must terminate.
+    """
+    rng = _random.Random(int(seed))
+    n = rng.randint(1, max_faults)
+    entries = []
+    for _ in range(n):
+        site = rng.choice(list(sites))
+        hit = rng.randint(1, 3)
+        entries.append(f"{site}:exc@{hit}")
+    plan = ",".join(entries)
+    parse_plan(plan)  # a generator bug must fail here, not mid-chaos-run
+    return plan
+
+
+def _reset_for_tests():
+    """Forget cached plan + hit counters (unit tests only)."""
+    _state.update(pid=None, plan=None, faults=(), hits={})

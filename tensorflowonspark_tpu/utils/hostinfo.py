@@ -87,6 +87,17 @@ def read_child_pids(cwd=None):
         return []
 
 
+def clear_child_pids(cwd=None):
+    """Forget the child pids recorded for ``cwd``.  Called after an
+    executor respawn has reaped the dead incarnation's children, so the
+    replacement's pid file starts clean."""
+    path = os.path.join(cwd or os.getcwd(), _CHILD_PIDS_FILE)
+    try:
+        os.remove(path)
+    except OSError:
+        pass
+
+
 def kill_pid(pid, sig=None):
     """Send ``sig`` (default SIGKILL) to pid; True if the signal was sent."""
     import signal as _signal
