@@ -242,16 +242,18 @@ class LocalDataset:
                               placement=placement, retryable=retryable,
                               max_retries=max_retries)
 
-    def collect(self, spread=False):
+    def collect(self, spread=False, retryable=False, max_retries=None):
         """Materialize all partitions.  ``spread=True`` pins task i to
         executor i (one concurrent task per slot — the barrier-execution
-        guarantee TFParallel-style jobs need)."""
+        guarantee TFParallel-style jobs need).  ``retryable`` as in
+        :meth:`foreach_partition` — only for idempotent lineages."""
         tasks = [
             (items, chain if chain is not None else (lambda it: list(it)))
             for items, chain in self._tasks()
         ]
         parts = self._engine._run_job(
-            tasks, collect=True, spread=spread, placement=None
+            tasks, collect=True, spread=spread, placement=None,
+            retryable=retryable, max_retries=max_retries
         )
         out = []
         for p in parts:
@@ -650,6 +652,16 @@ class LocalEngine:
                 inbox.put(("stop",))
             except (OSError, ValueError):
                 pass
+        # A dead executor never drains its inbox; if an undelivered task
+        # blob exceeds the pipe buffer, the queue's feeder thread blocks
+        # in write() forever and multiprocessing's atexit join would hang
+        # interpreter exit on it.  The engine is going away — never wait
+        # for a flush to a reader that may not exist.
+        for q in (self._shared_inbox, self._results, *self._own_inboxes):
+            try:
+                q.cancel_join_thread()
+            except (OSError, ValueError):
+                pass
         deadline = time.time() + 5
         for p in self._procs:
             p.join(timeout=max(0.1, deadline - time.time()))
@@ -716,7 +728,8 @@ class SparkDataset:
         else:
             self.rdd.foreachPartition(fn)
 
-    def collect(self, spread=False):
+    def collect(self, spread=False, retryable=False, max_retries=None):
+        del retryable, max_retries  # supervised by spark.task.maxFailures
         if spread:
             def _identity(it):
                 return it

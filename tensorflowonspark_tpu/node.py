@@ -49,6 +49,20 @@ logger = logging.getLogger(__name__)
 # Records per queue chunk on the feed path; one IPC hop per chunk.
 FEED_CHUNK_RECORDS = int(os.environ.get("TFOS_FEED_CHUNK", "1024"))
 
+
+def _feed_chunk_records():
+    """Chunk size resolved where the feeder RUNS, not where it was pickled.
+
+    The feeder closures are cloudpickled by value, which snapshots module
+    globals from the driver — so :data:`FEED_CHUNK_RECORDS` as seen by an
+    executor would silently be the *driver's* import-time value.  Reading
+    the env at call time lets per-executor overrides (``LocalEngine(env=
+    {"TFOS_FEED_CHUNK": ...})``) actually pace the feed."""
+    try:
+        return int(os.environ.get("TFOS_FEED_CHUNK", "")) or FEED_CHUNK_RECORDS
+    except ValueError:
+        return FEED_CHUNK_RECORDS
+
 COMPUTE_JOBS = ("chief", "master", "worker")
 
 
@@ -783,9 +797,10 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input",
         total = 0
         terminated = False
         chunk = []
+        chunk_records = _feed_chunk_records()
         for item in iterator:
             chunk.append(item)
-            if len(chunk) >= FEED_CHUNK_RECORDS:
+            if len(chunk) >= chunk_records:
                 if not put(chunk):
                     terminated = True
                     break
@@ -868,9 +883,10 @@ def inference(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
 
         count = 0
         chunk = []
+        chunk_records = _feed_chunk_records()
         for item in iterator:
             chunk.append(item)
-            if len(chunk) >= FEED_CHUNK_RECORDS:
+            if len(chunk) >= chunk_records:
                 put(chunk)
                 count += len(chunk)
                 chunk = []

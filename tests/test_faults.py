@@ -1,0 +1,239 @@
+"""Unit tests for the deterministic fault-injection harness
+(tensorflowonspark_tpu/utils/faults.py) and its wiring into each runtime
+injection point."""
+
+import os
+import time
+
+import pytest
+
+from tensorflowonspark_tpu import rendezvous
+from tensorflowonspark_tpu.utils import faults
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_env(monkeypatch):
+    monkeypatch.delenv(faults.PLAN_ENV, raising=False)
+    monkeypatch.delenv(faults.EXECUTOR_ENV, raising=False)
+    monkeypatch.delenv("TFOS_EXECUTOR_INDEX", raising=False)
+    faults._reset_for_tests()
+    yield
+    faults._reset_for_tests()
+
+
+def _arm(monkeypatch, plan, executor=None):
+    monkeypatch.setenv(faults.PLAN_ENV, plan)
+    if executor is not None:
+        monkeypatch.setenv(faults.EXECUTOR_ENV, str(executor))
+    faults._reset_for_tests()
+
+
+# --- parser -----------------------------------------------------------------
+
+def test_parse_plan_variants():
+    fs = faults.parse_plan(
+        "engine.task:exc@2, node.boot:hang(0.5)@3+ ,feed.get:delay(2)@*,"
+        "checkpoint.save:kill"
+    )
+    assert [f.site for f in fs] == [
+        "engine.task", "node.boot", "feed.get", "checkpoint.save"]
+    assert (fs[0].kind, fs[0].first, fs[0].last) == ("exc", 2, 2)
+    assert (fs[1].kind, fs[1].arg, fs[1].first, fs[1].last) == (
+        "hang", 0.5, 3, None)
+    assert (fs[2].kind, fs[2].arg, fs[2].first, fs[2].last) == (
+        "delay", 2.0, 1, None)
+    assert (fs[3].kind, fs[3].first, fs[3].last) == ("kill", 1, 1)
+
+
+def test_parse_plan_empty():
+    assert faults.parse_plan("") == []
+    assert faults.parse_plan(None) == []
+    assert faults.parse_plan(" , ,") == []
+
+
+@pytest.mark.parametrize("bad", [
+    "engine.task",               # no kind
+    "nosite:exc",                # unknown site
+    "engine.task:boom",          # unknown kind
+    "engine.task:exc@0",         # hits are 1-based
+    "engine.task:hang(x)",       # non-numeric arg
+    "engine.task:hang(1",        # unclosed arg
+])
+def test_parse_plan_rejects(bad):
+    with pytest.raises(ValueError):
+        faults.parse_plan(bad)
+
+
+# --- hit semantics ----------------------------------------------------------
+
+def test_exc_fires_on_exact_hit(monkeypatch):
+    _arm(monkeypatch, "engine.task:exc@2")
+    faults.check("engine.task")  # hit 1: no fire
+    with pytest.raises(faults.FaultInjected):
+        faults.check("engine.task")  # hit 2: fire
+    faults.check("engine.task")  # hit 3: past the window
+
+
+def test_open_ended_and_star_hits(monkeypatch):
+    _arm(monkeypatch, "engine.task:exc@2+")
+    faults.check("engine.task")
+    for _ in range(3):
+        with pytest.raises(faults.FaultInjected):
+            faults.check("engine.task")
+    _arm(monkeypatch, "node.boot:exc@*")
+    for _ in range(3):
+        with pytest.raises(faults.FaultInjected):
+            faults.check("node.boot")
+
+
+def test_sites_count_independently(monkeypatch):
+    _arm(monkeypatch, "engine.task:exc@2,node.boot:exc@1")
+    with pytest.raises(faults.FaultInjected):
+        faults.check("node.boot")
+    faults.check("engine.task")  # engine.task still at hit 1
+    with pytest.raises(faults.FaultInjected):
+        faults.check("engine.task")
+
+
+def test_unplanned_sites_free(monkeypatch):
+    _arm(monkeypatch, "engine.task:exc@1")
+    for _ in range(5):
+        faults.check("feed.get")
+
+
+def test_delay_sleeps_then_continues(monkeypatch):
+    _arm(monkeypatch, "feed.get:delay(0.2)@1")
+    t0 = time.monotonic()
+    faults.check("feed.get")
+    assert time.monotonic() - t0 >= 0.2
+    faults.check("feed.get")  # hit 2: no delay
+
+
+def test_hang_expires_into_exception(monkeypatch):
+    _arm(monkeypatch, "node.main:hang(0.1)@1")
+    with pytest.raises(faults.FaultInjected, match="hang"):
+        faults.check("node.main")
+
+
+def test_invalid_plan_injects_nothing(monkeypatch):
+    _arm(monkeypatch, "engine.task:definitely-not-a-kind")
+    faults.check("engine.task")  # logged, not raised
+
+
+# --- scoping ----------------------------------------------------------------
+
+def test_executor_scope_filters(monkeypatch):
+    _arm(monkeypatch, "engine.task:exc@1", executor=1)
+    monkeypatch.setenv("TFOS_EXECUTOR_INDEX", "0")
+    faults.check("engine.task")  # wrong executor: no fire
+    monkeypatch.setenv("TFOS_EXECUTOR_INDEX", "1")
+    with pytest.raises(faults.FaultInjected):
+        faults.check("engine.task")
+
+
+# --- chaos plan generator ---------------------------------------------------
+
+def test_random_plan_deterministic_and_valid():
+    a = faults.random_plan(1234)
+    assert a == faults.random_plan(1234)
+    assert a != faults.random_plan(1235) or True  # may collide; parse matters
+    for seed in range(20):
+        plan = faults.random_plan(seed)
+        for f in faults.parse_plan(plan):
+            assert f.site in faults.CHAOS_SITES
+            assert f.kind == "exc"
+
+
+# --- telemetry --------------------------------------------------------------
+
+def test_fired_fault_emits_telemetry(monkeypatch, tmp_path):
+    from tensorflowonspark_tpu.utils import telemetry
+
+    # earlier in-process cluster tests may leave a stale spool/identity in
+    # os.environ, which would redirect the event away from tmp_path
+    for var in (telemetry.SPOOL_ENV, telemetry.NODE_ENV, telemetry.ROLE_ENV):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv(telemetry.DIR_ENV, str(tmp_path))
+    telemetry.configure(node_id="t", role="test")
+    _arm(monkeypatch, "engine.task:exc@1")
+    with pytest.raises(faults.FaultInjected):
+        faults.check("engine.task", job=7, task=3)
+    text = "".join(
+        p.read_text() for p in tmp_path.rglob("*") if p.is_file())
+    assert "fault/injected" in text
+    assert '"job": 7' in text or '"job":7' in text
+
+
+# --- integration: each wired site actually fires ----------------------------
+
+def test_checkpoint_save_site(monkeypatch, tmp_path):
+    from tensorflowonspark_tpu.utils import checkpoint
+
+    _arm(monkeypatch, "checkpoint.save:exc@1")
+    with pytest.raises(faults.FaultInjected):
+        checkpoint.save_checkpoint(str(tmp_path / "ck"), {"w": 1.0}, 1)
+    # hit 2: save succeeds (counter advanced by the failed attempt)
+    path = checkpoint.save_checkpoint(str(tmp_path / "ck"), {"w": 1.0}, 2)
+    assert path.endswith("ckpt-00000002.npz")
+
+
+def test_rendezvous_register_and_query_sites(monkeypatch):
+    server = rendezvous.Server(1)
+    addr = server.start()
+    try:
+        _arm(monkeypatch, "rendezvous.register:exc@1")
+        client = rendezvous.Client(addr)
+        meta = {"executor_id": 0, "host": "h", "job_name": "worker",
+                "task_index": 0, "port": 1, "addr": ["h", 1], "authkey": ""}
+        with pytest.raises(faults.FaultInjected):
+            client.register(meta)
+        _arm(monkeypatch, "rendezvous.query:exc@1")
+        client.register(meta)
+        with pytest.raises(faults.FaultInjected):
+            client.await_reservations(timeout=5)
+        _arm(monkeypatch, "")  # disarm: query now completes
+        assert len(client.await_reservations(timeout=5)) == 1
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_feed_get_site(monkeypatch):
+    from tensorflowonspark_tpu.feed import DataFeed
+
+    class _KV:
+        def __init__(self):
+            self._q = None
+
+        def get(self, key):
+            return None  # no shm ring advertised
+
+        def get_queue(self, name):
+            import queue as q
+
+            if self._q is None:
+                self._q = q.Queue()
+            return self._q
+
+    mgr = _KV()
+    mgr.get_queue("input").put([1, 2, 3])
+    _arm(monkeypatch, "feed.get:exc@1")
+    feed = DataFeed(mgr, train_mode=True)
+    with pytest.raises(faults.FaultInjected):
+        feed.next_batch(3)
+
+
+def test_engine_task_site(monkeypatch):
+    from tensorflowonspark_tpu.engine import LocalEngine, TaskError
+
+    monkeypatch.setenv(faults.PLAN_ENV, "engine.task:exc@1")
+    monkeypatch.setenv("TFOS_TASK_RETRIES", "0")
+    eng = LocalEngine(1)
+    try:
+        with pytest.raises(TaskError, match="FaultInjected"):
+            eng.parallelize(range(4), 1).foreach_partition(
+                lambda it: list(it))
+    finally:
+        eng.stop()

@@ -1,0 +1,331 @@
+"""Supervision-layer tests: engine retry/backoff/respawn, failure
+detection latency, manager heartbeat liveness, and rendezvous epoch
+fencing + feed ledger (the building blocks of cluster.run(restarts=N))."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from tensorflowonspark_tpu import manager as tfmanager
+from tensorflowonspark_tpu import rendezvous
+from tensorflowonspark_tpu.engine import LocalEngine, ResultPumpError, TaskError
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture()
+def engine(tmp_path):
+    eng = LocalEngine(2, workdir=str(tmp_path / "eng"))
+    yield eng
+    eng.stop()
+
+
+# --- task closures (module-level: shipped to executor processes) ------------
+
+def _flaky_fn(marker_dir):
+    """Fails the first attempt of each task, succeeds on retry (attempt
+    counted via a marker file — survives the executor process)."""
+
+    def _fn(it):
+        items = list(it)
+        mark = os.path.join(marker_dir, f"attempt-{items[0]}")
+        if not os.path.exists(mark):
+            with open(mark, "w") as f:
+                f.write("1")
+            raise RuntimeError(f"flaky failure on items {items}")
+        return items
+
+    return _fn
+
+
+def _poison_fn(it):
+    raise RuntimeError("permanently poisoned task")
+
+
+def _touch_then_block_fn(marker_dir):
+    def _fn(it):
+        items = list(it)
+        with open(os.path.join(marker_dir, f"started-{items[0]}"), "w") as f:
+            f.write("1")
+        time.sleep(60)
+        return items
+
+    return _fn
+
+
+def _touch_then_sleep_briefly_fn(marker_dir):
+    def _fn(it):
+        items = list(it)
+        path = os.path.join(marker_dir, f"started-{items[0]}")
+        first = not os.path.exists(path)
+        with open(path, "a") as f:
+            f.write("x")
+        if first and items[0] == 2:
+            time.sleep(30)  # first attempt of task 1: wait to be killed
+        return items
+
+    return _fn
+
+
+def _unpicklable_fn(it):
+    return [(x for x in range(3))]  # generators cannot be pickled
+
+
+# --- engine retry / poison --------------------------------------------------
+
+def test_flaky_task_retries_to_success(engine, tmp_path):
+    d = str(tmp_path)
+    out = (engine.parallelize(range(4), 2)
+           .map_partitions(_flaky_fn(d)).collect(spread=True, retryable=True))
+    assert sorted(out) == [0, 1, 2, 3]
+    # both tasks failed once then succeeded
+    assert sorted(os.listdir(d)) == ["attempt-0", "attempt-2", "eng"]
+
+
+def test_poison_task_fails_permanently_with_chain(engine):
+    t0 = time.monotonic()
+    with pytest.raises(TaskError) as ei:
+        engine.parallelize(range(2), 1).foreach_partition(
+            _poison_fn, retryable=True)
+    msg = str(ei.value)
+    assert "permanently poisoned task" in msg
+    assert "permanent after 3 attempts" in msg
+    assert "earlier attempt" in msg
+    assert time.monotonic() - t0 < 30
+
+
+def test_non_retryable_fails_fast_unchanged(engine):
+    with pytest.raises(TaskError) as ei:
+        engine.parallelize(range(2), 1).foreach_partition(_poison_fn)
+    assert "task 0 failed on executor:" in str(ei.value)
+    assert "permanent after" not in str(ei.value)
+
+
+def test_retry_env_budget(tmp_path, monkeypatch):
+    monkeypatch.setenv("TFOS_TASK_RETRIES", "0")
+    eng = LocalEngine(1, workdir=str(tmp_path / "eng"))
+    try:
+        with pytest.raises(TaskError):
+            eng.parallelize(range(2), 1).foreach_partition(
+                _flaky_fn(str(tmp_path)), retryable=True)
+    finally:
+        eng.stop()
+
+
+# --- executor loss ----------------------------------------------------------
+
+def test_sigkill_detected_fast_when_not_retryable(engine, tmp_path):
+    d = str(tmp_path)
+    errors = []
+
+    def _job():
+        try:
+            engine.parallelize(range(2), 2).foreach_partition(
+                _touch_then_block_fn(d), spread=True)
+        except TaskError as e:
+            errors.append(e)
+
+    t = threading.Thread(target=_job)
+    t.start()
+    deadline = time.monotonic() + 20
+    while not os.path.exists(os.path.join(d, "started-1")):
+        assert time.monotonic() < deadline, "task 1 never started"
+        time.sleep(0.05)
+    t0 = time.monotonic()
+    os.kill(engine._procs[1].pid, 9)
+    t.join(timeout=15)
+    latency = time.monotonic() - t0
+    assert errors, "executor death was not detected"
+    assert "died with tasks in flight" in str(errors[0])
+    assert latency < 10, f"death detection took {latency:.1f}s"
+
+
+def test_sigkill_respawn_completes_job(engine, tmp_path):
+    d = str(tmp_path)
+    results = []
+    errors = []
+
+    def _job():
+        try:
+            results.extend(
+                engine.parallelize(range(4), 2)
+                .map_partitions(_touch_then_sleep_briefly_fn(d))
+                .collect(spread=True, retryable=True))
+        except TaskError as e:  # pragma: no cover - failure detail
+            errors.append(e)
+
+    t = threading.Thread(target=_job)
+    t.start()
+    deadline = time.monotonic() + 20
+    while not os.path.exists(os.path.join(d, "started-2")):
+        assert time.monotonic() < deadline, "task 1 never started"
+        time.sleep(0.05)
+    os.kill(engine._procs[1].pid, 9)
+    t.join(timeout=60)
+    assert not t.is_alive(), "job hung after executor kill"
+    assert not errors, f"job failed: {errors}"
+    assert sorted(results) == [0, 1, 2, 3]
+    assert engine._respawns >= 1
+
+
+def test_respawn_budget_exhaustion(tmp_path, monkeypatch):
+    monkeypatch.setenv("TFOS_EXECUTOR_RESPAWNS", "0")
+    eng = LocalEngine(1, workdir=str(tmp_path / "eng"))
+    try:
+        d = str(tmp_path)
+        errors = []
+
+        def _job():
+            try:
+                eng.parallelize(range(1), 1).foreach_partition(
+                    _touch_then_block_fn(d), spread=True, retryable=True)
+            except TaskError as e:
+                errors.append(e)
+
+        t = threading.Thread(target=_job)
+        t.start()
+        deadline = time.monotonic() + 20
+        while not os.path.exists(os.path.join(d, "started-0")):
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        os.kill(eng._procs[0].pid, 9)
+        t.join(timeout=30)
+        assert errors and "respawn budget" in str(errors[0])
+    finally:
+        eng.stop()
+
+
+# --- result transport -------------------------------------------------------
+
+def test_unpicklable_result_fails_only_its_job(engine):
+    with pytest.raises(TaskError):
+        engine.parallelize(range(2), 1).map_partitions(
+            _unpicklable_fn).collect()
+    # engine still works for the next job
+    out = engine.parallelize(range(4), 2).map_partitions(
+        lambda it: [sum(it)]).collect()
+    assert sorted(out) == [1, 5]
+
+
+def test_result_pump_error_is_typed():
+    assert issubclass(ResultPumpError, TaskError)
+
+
+# --- heartbeat liveness -----------------------------------------------------
+
+class _FakeMgr:
+    def __init__(self):
+        self.kv = {}
+
+    def set(self, k, v):
+        self.kv[k] = v
+
+    def get(self, k):
+        return self.kv.get(k)
+
+
+def test_heartbeat_age_unknown_without_beat():
+    assert tfmanager.heartbeat_age(_FakeMgr()) is None
+
+
+def test_heartbeat_age_tracks_beats():
+    mgr = _FakeMgr()
+    tfmanager.beat(mgr)
+    assert tfmanager.heartbeat_age(mgr) < 1.0
+    mgr.set(tfmanager.HEARTBEAT_KEY, time.time() - 120)
+    assert tfmanager.heartbeat_age(mgr) > 100
+
+
+def test_heartbeat_thread_beats_and_stops():
+    mgr = _FakeMgr()
+    stop = tfmanager.start_heartbeat(mgr, interval=0.05)
+    deadline = time.monotonic() + 5
+    while tfmanager.heartbeat_age(mgr) is None:
+        assert time.monotonic() < deadline
+        time.sleep(0.02)
+    stop.set()
+
+
+def test_stale_tunable(monkeypatch):
+    monkeypatch.setenv("TFOS_HEARTBEAT_STALE", "3.5")
+    assert tfmanager.stale_after() == 3.5
+
+
+# --- rendezvous epoch fencing + feed ledger ---------------------------------
+
+def _meta(executor_id, **kw):
+    m = {"executor_id": executor_id, "host": "h", "job_name": "worker",
+         "task_index": executor_id, "port": 1, "addr": ["h", 1],
+         "authkey": ""}
+    m.update(kw)
+    return m
+
+
+def test_epoch_mismatch_rejected():
+    server = rendezvous.Server(1)
+    addr = server.start()
+    try:
+        server.reset(epoch=2)
+        client = rendezvous.Client(addr)
+        with pytest.raises(RuntimeError, match="epoch 0 != cluster epoch 2"):
+            client.register(_meta(0), epoch=0)
+        client.register(_meta(0), epoch=2)
+        assert len(client.await_reservations(timeout=5)) == 1
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_reregistration_replaces_same_executor():
+    server = rendezvous.Server(2)
+    addr = server.start()
+    try:
+        client = rendezvous.Client(addr)
+        client.register(_meta(0, port=10))
+        client.register(_meta(0, port=20))  # respawned node, same executor
+        client.register(_meta(1))
+        info = client.await_reservations(timeout=5)
+        assert len(info) == 2
+        assert {m["port"] for m in info if m["executor_id"] == 0} == {20}
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_reset_clears_reservations_keeps_feed_ledger():
+    server = rendezvous.Server(1)
+    addr = server.start()
+    try:
+        client = rendezvous.Client(addr)
+        client.register(_meta(0))
+        client.partition_done("input", 0)
+        client.partition_done("input", 2)
+        client.partition_done("eval", 7)
+        server.reset(epoch=1)
+        assert server.reservations.remaining() == 1  # table wiped
+        assert client.fed_partitions("input") == [0, 2]
+        assert client.fed_partitions("eval") == [7]
+        server.reset_feed("input")
+        assert client.fed_partitions("input") == []
+        assert client.fed_partitions("eval") == [7]
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_idempotent_call_reconnects_transparently():
+    server = rendezvous.Server(1)
+    addr = server.start()
+    try:
+        client = rendezvous.Client(addr)
+        client.register(_meta(0))
+        client._sock.close()  # simulate a dropped connection
+        assert len(client.await_reservations(timeout=5)) == 1  # QUERY replays
+        client._sock.close()
+        with pytest.raises(ConnectionError):
+            client.request_stop()  # STOP is not idempotent: no replay
+        client.close()
+    finally:
+        server.stop()
