@@ -814,7 +814,8 @@ def main():
     # batch inference — small first-number runs, each independent
     for name, fn in (("tfrecord_read", _tfrecord_bench),
                      ("segmentation", _segmentation_bench),
-                     ("batch_inference", _inference_bench)):
+                     ("batch_inference", _inference_bench),
+                     ("serve", _serve_bench)):
         if os.environ.get(f"TFOS_BENCH_{name.upper()}", "1") != "0":
             try:
                 with telemetry.span(f"bench/{name}"):
@@ -1114,6 +1115,91 @@ def _inference_bench(dev, on_tpu):
                 "mfu": round(rps * flops / _peak_flops(dev), 6),
                 "fwd_flops_per_row": flops,
                 "rows": n_rows, "batch": 1024}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _serve_bench(dev, on_tpu):
+    """Online-serving lane (TFOS_BENCH_SERVE=0 to skip): a 2-replica
+    CPU service under concurrent in-process clients — latency
+    percentiles, req/s, shed rate, micro-batch coalescing and the
+    per-bucket compile counts (docs/serving.md).
+
+    Replicas are FORCED onto CPU regardless of the bench device: the
+    tunnel serializes TPU claims, and the main bench process holds the
+    claim — a second jax-on-axon process would wedge both.
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+
+    from tensorflowonspark_tpu import serving
+    from tensorflowonspark_tpu.models import mnist
+    from tensorflowonspark_tpu.utils import checkpoint as ckpt
+
+    replicas = int(os.environ.get("TFOS_BENCH_SERVE_REPLICAS", "2"))
+    clients = int(os.environ.get("TFOS_BENCH_SERVE_CLIENTS", "64"))
+    per_client = int(os.environ.get("TFOS_BENCH_SERVE_REQUESTS", "6"))
+    tmp = tempfile.mkdtemp(prefix="tfos_bench_serve_")
+    try:
+        params = mnist.init_params(jax.random.PRNGKey(0))
+        export = os.path.join(tmp, "export")
+        ckpt.export_model(export, params, metadata={
+            "predict": "tensorflowonspark_tpu.models.mnist:serve_predict",
+        })
+        spec = serving.ModelSpec(export_dir=export)
+        rng = np.random.default_rng(0)
+        images = rng.random((clients, 28, 28, 1), np.float32)
+        errors = [0]
+
+        with serving.Server(
+            spec, num_replicas=replicas, max_batch=32, max_delay_ms=5,
+            env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""},
+        ) as srv:
+            client = srv.client()
+            # warmup: first predicts pay jax import + bucket-1 compile
+            for _ in range(2):
+                client.predict({"image": images[0]}, timeout=120)
+
+            def burst(i):
+                for _ in range(per_client):
+                    try:
+                        client.predict({"image": images[i]}, timeout=120)
+                    except Exception:  # noqa: BLE001 - counted, not fatal
+                        errors[0] += 1
+
+            threads = [threading.Thread(target=burst, args=(i,))
+                       for i in range(clients)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+            summ = srv.summary(include_replicas=True)
+
+        total = clients * per_client
+        out = {
+            "requests": total,
+            "req_per_sec": round(total / dt, 1),
+            "p50_ms": summ.get("p50_ms"),
+            "p95_ms": summ.get("p95_ms"),
+            "p99_ms": summ.get("p99_ms"),
+            "shed_rate": summ.get("shed_rate"),
+            "mean_device_batch": summ.get("mean_device_batch"),
+            "buckets": summ.get("buckets"),
+            "replicas": replicas,
+            "client_errors": errors[0],
+        }
+        compiles = {}
+        for st in (summ.get("replica_stats") or {}).values():
+            for sig, n in (st.get("compiles") or {}).items():
+                compiles[sig] = compiles.get(sig, 0) + n
+        if compiles:
+            out["compiles"] = compiles
+        return out
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 

@@ -142,12 +142,16 @@ def summarize(pairs, skipped=0):
     recs = [rec for rec, _ in pairs]
     phases = {}
     per_node = {}
+    serve = {"totals_ms": [], "queue_ms": [], "device_ms": [],
+             "batches": [], "shed": 0}
     for rec in recs:
         node = per_node.setdefault(
             rec["node_id"],
             {"role": rec["role"], "steps_ms": [], "items": 0,
              "model_flops": 0.0, "peak_flops": None, "infeed_s": 0.0},
         )
+        if rec["name"] == "serve/shed":
+            serve["shed"] += 1
         if rec["kind"] != "span" or rec["dur_ms"] is None:
             continue
         ph = phases.setdefault(rec["name"], {"count": 0, "total_ms": 0.0,
@@ -166,6 +170,14 @@ def summarize(pairs, skipped=0):
                 node["peak_flops"] = float(attrs["peak_flops"])
         elif rec["name"] == "feed/wait":
             node["infeed_s"] += float(rec["dur_ms"]) / 1e3
+        elif rec["name"] == "serve/request":
+            serve["totals_ms"].append(float(rec["dur_ms"]))
+            if attrs.get("queue_ms") is not None:
+                serve["queue_ms"].append(float(attrs["queue_ms"]))
+            if attrs.get("device_ms") is not None:
+                serve["device_ms"].append(float(attrs["device_ms"]))
+            if attrs.get("batch"):
+                serve["batches"].append(float(attrs["batch"]))
 
     stats = {"records": len(recs), "skipped": skipped, "nodes": {},
              "phases": phases}
@@ -183,6 +195,40 @@ def summarize(pairs, skipped=0):
     for name, ph in sorted(phases.items(), key=lambda kv: -kv[1]["total_ms"]):
         lines.append(f"{name:<32} {ph['count']:>7} {ph['total_ms']:>12.1f} "
                      f"{ph['max_ms']:>10.1f}")
+
+    if serve["totals_ms"] or serve["shed"]:
+        # online-serving SLOs (docs/serving.md): per-request spans carry
+        # queue/device decomposition; sheds are instant events
+        totals = sorted(serve["totals_ms"])
+        n_req = len(totals)
+        shed = serve["shed"]
+        stats["serving"] = {
+            "requests": n_req,
+            "shed": shed,
+            "shed_rate": shed / (n_req + shed) if (n_req + shed) else 0.0,
+            "p50_ms": _pct(totals, 0.50),
+            "p95_ms": _pct(totals, 0.95),
+            "p99_ms": _pct(totals, 0.99),
+            "mean_queue_ms": (sum(serve["queue_ms"]) / len(serve["queue_ms"])
+                              if serve["queue_ms"] else 0.0),
+            "mean_device_ms": (sum(serve["device_ms"])
+                               / len(serve["device_ms"])
+                               if serve["device_ms"] else 0.0),
+            "mean_device_batch": (sum(serve["batches"])
+                                  / len(serve["batches"])
+                                  if serve["batches"] else 0.0),
+        }
+        s = stats["serving"]
+        lines.append("")
+        lines.append("-- serving (serve/request spans) --")
+        lines.append(
+            f"requests={n_req} shed={shed} shed_rate={s['shed_rate']:.3f} "
+            f"p50={s['p50_ms']:.1f}ms p95={s['p95_ms']:.1f}ms "
+            f"p99={s['p99_ms']:.1f}ms")
+        lines.append(
+            f"mean queue={s['mean_queue_ms']:.1f}ms "
+            f"device={s['mean_device_ms']:.1f}ms "
+            f"device batch={s['mean_device_batch']:.1f}")
 
     lines.append("")
     lines.append("-- per-node train steps --")

@@ -24,6 +24,8 @@ setup(
         "console_scripts": [
             # parity: the reference's spark-submit Inference.scala CLI
             "tfos-inference=tensorflowonspark_tpu.inference:main",
+            # online serving (docs/serving.md) — no reference equivalent
+            "tfos-serve=tensorflowonspark_tpu.serving.server:main",
         ],
     },
 )

@@ -51,6 +51,7 @@ _LAZY = {
     "dfutil": ("tensorflowonspark_tpu.dfutil", None),
     "infeed": ("tensorflowonspark_tpu.infeed", None),
     "pipeline": ("tensorflowonspark_tpu.pipeline", None),
+    "serving": ("tensorflowonspark_tpu.serving", None),
 }
 
 

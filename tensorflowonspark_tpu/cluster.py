@@ -207,6 +207,28 @@ class TFCluster:
             node.inference(self.cluster_info, self.cluster_meta, feed_timeout, qname)
         )
 
+    def serve(self, export_dir=None, ckpt_dir=None, num_replicas=None, **kw):
+        """Stand up an online inference service on this cluster's engine
+        (no reference equivalent — TensorFlowOnSpark delegates online
+        serving to TF Serving; see docs/serving.md and PARITY.md §2.2).
+
+        Call after :meth:`shutdown`: serving replicas are ordinary engine
+        jobs and need free executor slots.  Returns a started
+        ``serving.Server`` — the caller owns ``stop()`` (or use it as a
+        context manager).
+        """
+        from tensorflowonspark_tpu import serving
+
+        spec = serving.ModelSpec(
+            export_dir=export_dir,
+            ckpt_dir=ckpt_dir,
+            predict=kw.pop("predict", None),
+        )
+        n = num_replicas or self.meta["num_executors"]
+        server = serving.Server(spec, num_replicas=n, engine=self.engine, **kw)
+        server.start()
+        return server
+
     def shutdown(self, ssc=None, grace_secs=0, timeout=259200):
         """Stop the cluster and propagate errors
         (parity: TFCluster.shutdown :117-205)."""

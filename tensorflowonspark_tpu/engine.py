@@ -676,13 +676,18 @@ class LocalEngine:
         # each executor recorded those pids in its working dir — kill any
         # survivor so nothing outlives the engine (and nothing keeps the
         # resource-tracker pipe open past interpreter exit).
-        from tensorflowonspark_tpu.utils import kill_pid, read_child_pids
+        from tensorflowonspark_tpu.utils import (
+            clear_child_pids, kill_pid, read_child_pids,
+        )
 
         for d in self.executor_dirs:
             for pid in read_child_pids(d):
                 if kill_pid(pid, 0):  # still alive
                     logger.warning("stop: killing leftover child pid %d", pid)
                     kill_pid(pid)
+            # the ledger is spent once its pids are swept: clean it so a
+            # caller-provided workdir isn't left with pid droppings
+            clear_child_pids(d)
         if self._owns_root:
             shutil.rmtree(self._root, ignore_errors=True)
 

@@ -40,6 +40,12 @@ def build_parser():
     p.add_argument("--output_mapping", default=None,
                    help='JSON {tensor_name: column}')
     p.add_argument("--batch_size", type=int, default=128)
+    p.add_argument("--no_pad_partial", dest="pad_partial",
+                   action="store_false", default=True,
+                   help="disable padding the final partial batch up to "
+                        "--batch_size (padding keeps the predict shape "
+                        "constant — one compile; padded rows are sliced "
+                        "off the outputs)")
     p.add_argument("--signature_def_key", default=None,
                    help="module:function predict override")
     p.add_argument("--num_executors", type=int, default=2,
@@ -74,7 +80,10 @@ def run(args, source=None):
         )
         # set as ML Params (they win over args in merge_args_params —
         # same precedence as the reference's TFModel.setExportDir etc.)
-        model = pipeline.TFModel()
+        # pad_partial is a plain tf_arg (not an ML Param): padding the
+        # final partial batch keeps the predict shape constant — one
+        # compile; padded rows are sliced off the outputs
+        model = pipeline.TFModel({"pad_partial": args.pad_partial})
         settings = {
             "export_dir": args.export_dir,
             "batch_size": args.batch_size,

@@ -88,3 +88,17 @@ def predict(params, inputs):
         x = x.reshape(-1, 28, 28, 1)
     logits = np.asarray(apply(params, x))
     return {"prediction": logits.argmax(-1), "logits": logits}
+
+
+def serve_predict(params, inputs):
+    """jax-pure variant of :func:`predict` for the online serving path
+    (``tensorflowonspark_tpu.models.mnist:serve_predict``): no numpy
+    round-trips, so serving's per-bucket AOT compilation
+    (``jax.jit(fn).lower(...).compile()``) applies — one executable per
+    shape bucket (serving/replicas._Predictor)."""
+    (x,) = inputs.values()
+    x = jnp.asarray(x, jnp.float32)
+    if x.ndim == 2:  # flat 784 rows
+        x = x.reshape(-1, 28, 28, 1)
+    logits = apply(params, x)
+    return {"prediction": jnp.argmax(logits, axis=-1), "logits": logits}

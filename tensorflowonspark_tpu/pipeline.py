@@ -433,6 +433,32 @@ class TFModel(
             ds = _select_columns(ds, input_cols)
         return ds.map_partitions(_run_model(args))
 
+    def as_service(self, num_replicas=None, watch_model_dir=True, **kw):
+        """Turn this model into an online service (docs/serving.md).
+
+        The online analogue of :meth:`transform`: the same export
+        directory and predict resolution (``signature_def_key``
+        override), served by ``num_replicas`` supervised replicas behind
+        the micro-batcher.  ``watch_model_dir=True`` arms checkpoint
+        hot-reload against ``model_dir`` when one is set.  Extra kwargs
+        (``max_batch``, ``max_delay_ms``, ``queue_max``, ``engine``,
+        ``env``) pass through to :class:`serving.Server`; the caller
+        starts/stops the returned server.
+        """
+        from tensorflowonspark_tpu import serving
+
+        args = self.merge_args_params()
+        export_dir = getattr(args, "export_dir", None)
+        model_dir = getattr(args, "model_dir", None)
+        assert export_dir or model_dir, (
+            "as_service requires export_dir or model_dir")
+        spec = serving.ModelSpec(
+            export_dir=export_dir,
+            ckpt_dir=model_dir if watch_model_dir else None,
+            predict=getattr(args, "signature_def_key", None),
+        )
+        return serving.Server(spec, num_replicas=num_replicas, **kw)
+
 
 def _run_model(args):
     """Partition closure: cached model, batched predict
@@ -479,12 +505,21 @@ def _run_model(args):
                 else:
                     cols = (np.asarray(batch),)
                 inputs = {t: cols[i] for i, t in enumerate(input_tensors)}
+            n = len(batch)
+            if n < args.batch_size and getattr(args, "pad_partial", True):
+                # final partial batch: pad rows up to batch_size so the
+                # jitted predict reuses the full-batch executable instead
+                # of compiling a second shape (the serving bucket-pad
+                # helper; padded rows are sliced back off below)
+                from tensorflowonspark_tpu.serving import batcher as _b
+
+                inputs = _b.pad_columns(inputs, args.batch_size)
             outputs = predict(params, inputs)
             if not isinstance(outputs, dict):
                 name = out_pairs[0][0] if out_pairs else "outputs"
                 outputs = {name: outputs}
-            outputs = {k: np.asarray(v) for k, v in outputs.items()}
-            n = len(batch)
+            # mask padded rows: only the first n rows are real
+            outputs = {k: np.asarray(v)[:n] for k, v in outputs.items()}
             for v in outputs.values():
                 assert len(v) == n, f"output rows {len(v)} != input rows {n}"
             names = [t for t, _ in out_pairs] if out_pairs else sorted(outputs)

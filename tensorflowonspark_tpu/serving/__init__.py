@@ -1,0 +1,34 @@
+"""Online inference serving (docs/serving.md).
+
+No reference equivalent — the reference stack stops at offline batch
+inference (Inference.scala:27-79); this subsystem turns an exported
+model into a low-latency online service on the existing cluster runtime
+(engine supervision + manager IPC + checkpoint restore + telemetry),
+see PARITY.md §2.2.
+
+Pieces:
+  - :mod:`~tensorflowonspark_tpu.serving.batcher` — dynamic
+    micro-batching into padded power-of-two shape buckets;
+  - :mod:`~tensorflowonspark_tpu.serving.replicas` — supervised model
+    replicas with least-loaded dispatch and checkpoint hot-reload;
+  - :mod:`~tensorflowonspark_tpu.serving.server` — in-process Client,
+    stdlib HTTP endpoint, SLO stats, ``tfos-serve`` CLI.
+"""
+
+from tensorflowonspark_tpu.serving.batcher import (  # noqa: F401
+    MicroBatcher,
+    Overloaded,
+    bucket_size,
+    pad_columns,
+    pad_rows,
+)
+from tensorflowonspark_tpu.serving.replicas import (  # noqa: F401
+    ModelSpec,
+    ReplicaPool,
+)
+from tensorflowonspark_tpu.serving.server import (  # noqa: F401
+    Client,
+    Server,
+    SLOStats,
+    serve_http,
+)

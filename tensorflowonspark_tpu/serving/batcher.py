@@ -1,0 +1,370 @@
+"""Dynamic micro-batcher: coalesce concurrent requests into padded,
+power-of-two shape-bucket batches.
+
+No reference equivalent — the reference stack stops at offline batch
+inference (Inference.scala:27-79, pipeline.py:585-644 `_run_model_tf2`);
+this extends the batched-predict idea of our `pipeline.yield_batch`
+(reference pipeline.py:688-710) to an *online* request path.
+
+Why buckets: a jitted predict compiles once per distinct input shape.
+Concurrent requests arrive in arbitrary counts, so a naive batcher would
+present every batch size from 1..max and compile each one.  Rounding the
+batch up to the next power of two (capped at ``TFOS_SERVE_MAX_BATCH``)
+and padding the rows bounds the number of executables at
+``log2(max_batch)+1`` per input signature — compile once per bucket,
+never per request.
+
+Latency contract: the first queued request waits at most
+``TFOS_SERVE_MAX_DELAY_MS`` for co-batchable traffic before the batch is
+flushed (deadline flush); a full batch flushes immediately.
+
+Admission control: once the number of queued-but-unbatched requests
+exceeds ``TFOS_SERVE_QUEUE_MAX``, ``submit`` sheds load by raising
+:class:`Overloaded` (the HTTP frontend maps it to 503 + Retry-After)
+instead of growing the queue without bound.
+
+Pure stdlib + numpy: importable by engine executors and the driver alike
+(never pulls jax — the replica side owns compilation, see replicas.py).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import os
+import queue as _queue
+import threading
+import time
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+MAX_BATCH_ENV = "TFOS_SERVE_MAX_BATCH"
+MAX_DELAY_ENV = "TFOS_SERVE_MAX_DELAY_MS"
+QUEUE_MAX_ENV = "TFOS_SERVE_QUEUE_MAX"
+TIMEOUT_ENV = "TFOS_SERVE_TIMEOUT"
+
+
+def max_batch_default():
+    return int(os.environ.get(MAX_BATCH_ENV, "64"))
+
+
+def max_delay_ms_default():
+    return float(os.environ.get(MAX_DELAY_ENV, "10"))
+
+
+def queue_max_default():
+    return int(os.environ.get(QUEUE_MAX_ENV, "1024"))
+
+
+def request_timeout_default():
+    return float(os.environ.get(TIMEOUT_ENV, "30"))
+
+
+def bucket_size(n, cap=None):
+    """Smallest power of two >= n, capped at ``cap`` (default
+    TFOS_SERVE_MAX_BATCH).  The cap itself is always a legal bucket even
+    when it is not a power of two — a full batch pads nothing."""
+    cap = max_batch_default() if cap is None else int(cap)
+    if n >= cap:
+        return cap
+    b = 1
+    while b < n:
+        b <<= 1
+    return min(b, cap)
+
+
+def pad_rows(arr, target):
+    """Pad ``arr`` along axis 0 up to ``target`` rows by edge-replication
+    (real rows repeated, so padded compute stays numerically in-domain —
+    no NaN-able zeros into normalization layers)."""
+    arr = np.asarray(arr)
+    n = arr.shape[0] if arr.ndim else 0
+    if arr.ndim == 0:
+        raise ValueError("pad_rows needs at least one (batch) axis")
+    if n == target:
+        return arr
+    if n > target:
+        raise ValueError(f"cannot pad {n} rows down to {target}")
+    if n == 0:
+        raise ValueError("cannot pad an empty batch (no row to replicate)")
+    widths = [(0, target - n)] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, widths, mode="edge")
+
+
+def pad_columns(cols, target):
+    """Pad every column of a batch (dict, tuple or list of arrays) up to
+    ``target`` rows; returns the same container type.  Shared by the
+    online batcher and the offline pipeline partial-batch path
+    (pipeline._run_model)."""
+    if isinstance(cols, dict):
+        return {k: pad_rows(v, target) for k, v in cols.items()}
+    return type(cols)(pad_rows(c, target) for c in cols)
+
+
+class Overloaded(RuntimeError):
+    """Admission control rejection: the pending-request queue is full.
+
+    ``retry_after`` (seconds) is advisory backoff for the client; the
+    HTTP frontend surfaces it as a ``Retry-After`` header on the 503.
+    """
+
+    def __init__(self, depth, limit, retry_after=0.1):
+        super().__init__(
+            f"serving queue full ({depth} pending > {limit}); retry in "
+            f"{retry_after:.2f}s")
+        self.depth = depth
+        self.limit = limit
+        self.retry_after = retry_after
+
+
+class PendingResult:
+    """One request's future: resolved by the batch that absorbed it."""
+
+    __slots__ = ("example", "attrs", "t_submit", "_event", "_value",
+                 "_error")
+
+    def __init__(self, example):
+        self.example = example
+        self.attrs = None            # timing attrs, set on resolve
+        self.t_submit = time.perf_counter()
+        self._event = threading.Event()
+        self._value = None
+        self._error = None
+
+    def done(self):
+        return self._event.is_set()
+
+    def result(self, timeout=None):
+        """Block for the outputs row ({tensor_name: ndarray}); raises the
+        batch's error, or TimeoutError after ``timeout`` seconds."""
+        timeout = request_timeout_default() if timeout is None else timeout
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"request not served within {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    # resolve-once: the first complete()/fail() of any batch attempt wins
+    def _set(self, value, attrs):
+        if not self._event.is_set():
+            self._value = value
+            self.attrs = attrs
+            self._event.set()
+
+    def _fail(self, exc):
+        if not self._event.is_set():
+            self._error = exc
+            self._event.set()
+
+
+class Batch:
+    """A padded device batch plus the requests it will resolve.
+
+    ``complete``/``fail`` are idempotent (first call wins): a batch
+    re-dispatched after a replica death may be answered twice, and the
+    duplicate must be a no-op rather than a double-resolve.
+    """
+
+    def __init__(self, batch_id, requests, inputs, bucket, assembly_ms,
+                 observer=None, batch_observer=None):
+        self.id = batch_id
+        self.requests = requests
+        self.inputs = inputs          # {tensor_name: [bucket, ...] ndarray}
+        self.n_valid = len(requests)
+        self.bucket = bucket
+        self.assembly_ms = assembly_ms
+        self.t_assembled = time.perf_counter()
+        self._observer = observer
+        self._batch_observer = batch_observer
+        self._resolved = False
+        self._lock = threading.Lock()
+
+    def _claim(self):
+        with self._lock:
+            if self._resolved:
+                return False
+            self._resolved = True
+            return True
+
+    def complete(self, outputs, meta=None):
+        """Resolve every request with its row of ``outputs`` (padded rows
+        beyond ``n_valid`` are discarded)."""
+        if not self._claim():
+            return False
+        meta = meta or {}
+        now = time.perf_counter()
+        device_ms = float(meta.get("device_ms") or 0.0)
+        for i, req in enumerate(self.requests):
+            row = {k: v[i] for k, v in outputs.items()}
+            attrs = {
+                "queue_ms": max(
+                    0.0, (self.t_assembled - req.t_submit) * 1e3
+                    - self.assembly_ms),
+                "batch_ms": self.assembly_ms,
+                "device_ms": device_ms,
+                "total_ms": (now - req.t_submit) * 1e3,
+                "batch": self.n_valid,
+                "bucket": self.bucket,
+            }
+            if self._observer is not None:
+                try:
+                    self._observer(attrs)
+                except Exception:  # noqa: BLE001 - stats must not drop replies
+                    logger.exception("serving request observer failed")
+            req._set(row, attrs)
+        if self._batch_observer is not None:
+            try:
+                self._batch_observer(self, meta)
+            except Exception:  # noqa: BLE001
+                logger.exception("serving batch observer failed")
+        return True
+
+    def fail(self, exc):
+        if not self._claim():
+            return False
+        for req in self.requests:
+            req._fail(exc)
+        return True
+
+
+def _signature(example):
+    """Shape/dtype signature grouping co-batchable examples."""
+    return tuple(
+        (k, tuple(np.shape(v)), str(np.asarray(v).dtype))
+        for k, v in sorted(example.items())
+    )
+
+
+_STOP = object()
+
+
+class MicroBatcher:
+    """Coalesce ``submit``-ed examples into bucket-padded batches and hand
+    them to ``dispatch`` (a non-blocking callable, e.g.
+    ``ReplicaPool.dispatch``) from a single batcher thread."""
+
+    def __init__(self, dispatch, max_batch=None, max_delay_ms=None,
+                 queue_max=None, observer=None, batch_observer=None,
+                 on_shed=None):
+        self._dispatch = dispatch
+        self.max_batch = max_batch or max_batch_default()
+        self.max_delay_s = (max_delay_ms_default() if max_delay_ms is None
+                            else float(max_delay_ms)) / 1e3
+        self.queue_max = queue_max or queue_max_default()
+        self._observer = observer
+        self._batch_observer = batch_observer
+        self._on_shed = on_shed
+        self._q = _queue.Queue()
+        self._ids = itertools.count(1)
+        self._closed = False
+        self._thread = None
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="tfos-serve-batcher", daemon=True)
+            self._thread.start()
+        return self
+
+    def submit(self, example):
+        """Queue one example ({tensor_name: array-like}, no batch axis);
+        returns a :class:`PendingResult`.  Raises :class:`Overloaded`
+        past ``queue_max`` pending requests (load shed)."""
+        if self._closed:
+            raise RuntimeError("batcher is closed")
+        if not isinstance(example, dict) or not example:
+            raise TypeError(
+                "example must be a non-empty {tensor_name: array} dict")
+        depth = self._q.qsize()
+        if depth >= self.queue_max:
+            # shed BEFORE enqueueing: bounded queue depth is the whole
+            # point — admitting then failing would still grow memory
+            if self._on_shed is not None:
+                try:
+                    self._on_shed(depth, self.queue_max)
+                except Exception:  # noqa: BLE001
+                    logger.exception("serving shed observer failed")
+            raise Overloaded(depth, self.queue_max,
+                             retry_after=max(self.max_delay_s, 0.05))
+        req = PendingResult(
+            {k: np.asarray(v) for k, v in example.items()})
+        self._q.put(req)
+        return req
+
+    def _loop(self):
+        while True:
+            try:
+                first = self._q.get(timeout=0.05)
+            except _queue.Empty:
+                if self._closed:
+                    return
+                continue
+            if first is _STOP:
+                return
+            reqs = [first]
+            deadline = time.perf_counter() + self.max_delay_s
+            stop = False
+            while len(reqs) < self.max_batch:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    r = self._q.get(timeout=remaining)
+                except _queue.Empty:
+                    break
+                if r is _STOP:
+                    stop = True
+                    break
+                reqs.append(r)
+            self._flush(reqs)
+            if stop:
+                return
+
+    def _flush(self, reqs):
+        """Stack one gathered wave into per-signature bucket batches."""
+        groups = {}
+        for req in reqs:
+            groups.setdefault(_signature(req.example), []).append(req)
+        for members in groups.values():
+            t0 = time.perf_counter()
+            try:
+                cols = {
+                    k: np.stack([m.example[k] for m in members])
+                    for k in members[0].example
+                }
+                bucket = bucket_size(len(members), self.max_batch)
+                cols = pad_columns(cols, bucket)
+            except Exception as e:  # noqa: BLE001 - bad example payloads
+                for m in members:
+                    m._fail(e)
+                continue
+            batch = Batch(
+                next(self._ids), members, cols, bucket,
+                (time.perf_counter() - t0) * 1e3,
+                observer=self._observer,
+                batch_observer=self._batch_observer,
+            )
+            try:
+                self._dispatch(batch)
+            except Exception as e:  # noqa: BLE001 - pool refused the batch
+                batch.fail(e)
+
+    def close(self, timeout=5.0):
+        """Stop the batcher thread; queued-but-unflushed requests are
+        failed so no client blocks into its full timeout on shutdown."""
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(_STOP)
+        if self._thread is not None:
+            self._thread.join(timeout)
+        err = RuntimeError("server shut down before the request was batched")
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except _queue.Empty:
+                break
+            if req is not _STOP:
+                req._fail(err)

@@ -1,0 +1,354 @@
+"""Serving frontend: in-process Client, stdlib HTTP endpoint, SLO stats.
+
+No reference equivalent (the reference's only inference surface is the
+spark-submit batch CLI, Inference.scala:27-79 → our inference.py); this
+is the online half, mirroring that CLI's conventions as the
+``tfos-serve`` console entry point.
+
+Composition: ``Server`` = :class:`~.replicas.ReplicaPool` (supervised
+model replicas) + :class:`~.batcher.MicroBatcher` (request coalescing)
++ :class:`SLOStats` (latency percentiles, shed rate, device-batch
+sizes).  Every completed request is recorded as a
+``telemetry.SERVE_REQUEST`` span carrying ``queue_ms`` /
+``batch_ms`` / ``device_ms`` attrs; every load-shed rejection is a
+``telemetry.SERVE_SHED`` event — ``scripts/trace_merge.py`` summarizes
+both into p50/p95/p99 and shed-rate.
+
+Admission control semantics (docs/serving.md): past
+``TFOS_SERVE_QUEUE_MAX`` pending requests, ``predict`` raises
+:class:`~.batcher.Overloaded`; the HTTP frontend maps it to
+``503`` + ``Retry-After``.  Shed requests are *rejected*, never
+silently dropped — a client always gets an answer or an explicit error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from tensorflowonspark_tpu.serving import batcher as _batcher
+from tensorflowonspark_tpu.serving.batcher import MicroBatcher, Overloaded
+from tensorflowonspark_tpu.serving.replicas import ModelSpec, ReplicaPool
+from tensorflowonspark_tpu.utils import telemetry
+
+logger = logging.getLogger(__name__)
+
+
+def _pct(sorted_vals, q):
+    """Nearest-rank percentile (same convention as scripts/trace_merge)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+class SLOStats:
+    """Thread-safe request/batch/shed counters + latency percentiles."""
+
+    def __init__(self, sample_cap=100_000):
+        self._lock = threading.Lock()
+        self._cap = sample_cap
+        self.total_ms = []
+        self.queue_ms = []
+        self.device_ms = []
+        self.completed = 0
+        self.shed = 0
+        self.errors = 0
+        self.batches = 0
+        self.batch_rows = 0
+        self.buckets = set()
+
+    def observe_request(self, attrs):
+        with self._lock:
+            self.completed += 1
+            if len(self.total_ms) < self._cap:
+                self.total_ms.append(attrs["total_ms"])
+                self.queue_ms.append(attrs["queue_ms"])
+                self.device_ms.append(attrs["device_ms"])
+
+    def observe_batch(self, batch, meta):
+        del meta
+        with self._lock:
+            self.batches += 1
+            self.batch_rows += batch.n_valid
+            self.buckets.add(batch.bucket)
+
+    def observe_shed(self):
+        with self._lock:
+            self.shed += 1
+
+    def observe_error(self):
+        with self._lock:
+            self.errors += 1
+
+    def summary(self):
+        with self._lock:
+            totals = sorted(self.total_ms)
+            queues = sorted(self.queue_ms)
+            devices = sorted(self.device_ms)
+            completed, shed, errors = self.completed, self.shed, self.errors
+            batches, rows = self.batches, self.batch_rows
+            buckets = sorted(self.buckets)
+        seen = completed + shed + errors
+        return {
+            "requests": seen,
+            "completed": completed,
+            "shed": shed,
+            "errors": errors,
+            "shed_rate": round(shed / seen, 4) if seen else 0.0,
+            "p50_ms": round(_pct(totals, 0.50), 3),
+            "p95_ms": round(_pct(totals, 0.95), 3),
+            "p99_ms": round(_pct(totals, 0.99), 3),
+            "mean_queue_ms": (round(sum(queues) / len(queues), 3)
+                              if queues else 0.0),
+            "mean_device_ms": (round(sum(devices) / len(devices), 3)
+                               if devices else 0.0),
+            "batches": batches,
+            "mean_device_batch": (round(rows / batches, 2)
+                                  if batches else 0.0),
+            "buckets": buckets,
+        }
+
+
+class Server:
+    """An online model service over the cluster runtime.
+
+    Usage (in-process)::
+
+        spec = ModelSpec(export_dir=..., ckpt_dir=...)
+        srv = Server(spec, num_replicas=2).start()
+        row = srv.predict({"image": x})     # {tensor_name: ndarray}
+        srv.stop()
+
+    or over HTTP: ``serve_http(srv, port=8500)`` / the ``tfos-serve``
+    CLI.  ``engine=`` reuses an existing LocalEngine (e.g.
+    ``TFCluster.serve``); otherwise the server owns a fresh one sized to
+    ``num_replicas``.
+    """
+
+    def __init__(self, spec, num_replicas=None, max_batch=None,
+                 max_delay_ms=None, queue_max=None, engine=None, env=None,
+                 request_timeout=None):
+        self.spec = spec
+        self.stats = SLOStats()
+        self.request_timeout = (request_timeout
+                                or _batcher.request_timeout_default())
+        self.pool = ReplicaPool(
+            spec, num_replicas=num_replicas, engine=engine, env=env,
+            request_timeout=self.request_timeout)
+        self.batcher = MicroBatcher(
+            self.pool.dispatch, max_batch=max_batch,
+            max_delay_ms=max_delay_ms, queue_max=queue_max,
+            observer=self._on_request, batch_observer=self.stats.observe_batch,
+            on_shed=self._on_shed)
+        self._stopped = False
+
+    # -- observers (batcher -> stats + telemetry) ---------------------------
+    def _on_request(self, attrs):
+        self.stats.observe_request(attrs)
+        telemetry.record_span(
+            telemetry.SERVE_REQUEST, attrs["total_ms"] / 1e3,
+            queue_ms=round(attrs["queue_ms"], 3),
+            batch_ms=round(attrs["batch_ms"], 3),
+            device_ms=round(attrs["device_ms"], 3),
+            batch=attrs["batch"], bucket=attrs["bucket"])
+
+    def _on_shed(self, depth, limit):
+        self.stats.observe_shed()
+        telemetry.event(telemetry.SERVE_SHED, depth=depth, limit=limit)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self, timeout=180.0):
+        self.pool.start(timeout=timeout)
+        self.batcher.start()
+        return self
+
+    def stop(self):
+        if self._stopped:
+            return
+        self._stopped = True
+        self.batcher.close()
+        self.pool.stop()
+        telemetry.flush()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- request path -------------------------------------------------------
+    def predict(self, example, timeout=None):
+        """Serve one example ({tensor_name: array-like}, no batch axis);
+        returns the outputs row.  Raises Overloaded on load shed,
+        TimeoutError past ``timeout`` (default TFOS_SERVE_TIMEOUT)."""
+        req = self.batcher.submit(example)
+        try:
+            return req.result(timeout or self.request_timeout)
+        except Overloaded:
+            raise
+        except Exception:
+            self.stats.observe_error()
+            raise
+
+    def client(self):
+        return Client(self)
+
+    def summary(self, include_replicas=False):
+        """One JSON-able dict of SLO metrics (+ per-replica predictor
+        stats when asked — a live round-trip to every replica)."""
+        out = self.stats.summary()
+        out["replicas"] = self.pool.live_replicas()
+        out["versions"] = self.pool.versions()
+        if include_replicas:
+            out["replica_stats"] = self.pool.stats()
+        return out
+
+
+class Client:
+    """In-process client handle (the test-facing 'connection')."""
+
+    def __init__(self, server):
+        self._server = server
+
+    def predict(self, example, timeout=None):
+        return self._server.predict(example, timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# HTTP frontend (stdlib http.server; one thread per connection)
+# ---------------------------------------------------------------------------
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "tfos-serve/0.1"
+
+    def log_message(self, fmt, *args):  # route to logging, not stderr
+        logger.debug("http: " + fmt, *args)
+
+    def _reply(self, code, payload, headers=None):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        srv = self.server.tfos_server
+        if self.path == "/healthz":
+            live = srv.pool.live_replicas()
+            code = 200 if live else 503
+            self._reply(code, {"status": "ok" if live else "degraded",
+                               "replicas": live})
+        elif self.path == "/stats":
+            self._reply(200, srv.summary())
+        else:
+            self._reply(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        srv = self.server.tfos_server
+        if self.path != "/v1/predict":
+            self._reply(404, {"error": f"no route {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            inputs = payload.get("inputs")
+            if not isinstance(inputs, dict) or not inputs:
+                raise ValueError('body must be {"inputs": {name: values}}')
+            example = {k: np.asarray(v) for k, v in inputs.items()}
+        except (ValueError, TypeError) as e:
+            self._reply(400, {"error": str(e)})
+            return
+        try:
+            row = srv.predict(example)
+        except Overloaded as e:
+            # explicit load shed: 503 + retry-after (docs/serving.md)
+            self._reply(503, {"error": "overloaded",
+                              "retry_after": round(e.retry_after, 3)},
+                        headers={"Retry-After": f"{e.retry_after:.3f}"})
+            return
+        except TimeoutError as e:
+            self._reply(504, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 - surface, don't crash
+            self._reply(500, {"error": repr(e)})
+            return
+        self._reply(200, {
+            "outputs": {k: np.asarray(v).tolist() for k, v in row.items()}
+        })
+
+
+def serve_http(server, host="127.0.0.1", port=8500, block=True):
+    """Expose ``server`` over HTTP.  ``block=False`` runs the listener on
+    a daemon thread and returns the ``ThreadingHTTPServer`` (tests use
+    its ``.server_address`` for the ephemeral port)."""
+    httpd = ThreadingHTTPServer((host, port), _Handler)
+    httpd.daemon_threads = True
+    httpd.tfos_server = server
+    if block:
+        httpd.serve_forever()
+        return httpd
+    t = threading.Thread(target=httpd.serve_forever,
+                         name="tfos-serve-http", daemon=True)
+    t.start()
+    return httpd
+
+
+# ---------------------------------------------------------------------------
+# CLI (console entry point: tfos-serve, mirroring tfos-inference)
+# ---------------------------------------------------------------------------
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="tfos-serve",
+        description="Online inference serving for an exported model",
+    )
+    p.add_argument("--export_dir", default=None,
+                   help="export directory (utils.checkpoint.export_model)")
+    p.add_argument("--ckpt_dir", default=None,
+                   help="checkpoint dir to hot-reload params from")
+    p.add_argument("--signature_def_key", default=None,
+                   help="module:function predict override")
+    p.add_argument("--num_replicas", type=int, default=None,
+                   help=f"model replicas (default ${'{'}TFOS_SERVE_REPLICAS{'}'} or 2)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8500)
+    p.add_argument("--max_batch", type=int, default=None)
+    p.add_argument("--max_delay_ms", type=float, default=None)
+    p.add_argument("--queue_max", type=int, default=None)
+    return p
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    args = build_parser().parse_args(argv)
+    if not args.export_dir and not args.ckpt_dir:
+        build_parser().error("--export_dir or --ckpt_dir is required")
+    spec = ModelSpec(export_dir=args.export_dir, ckpt_dir=args.ckpt_dir,
+                     predict=args.signature_def_key)
+    server = Server(spec, num_replicas=args.num_replicas,
+                    max_batch=args.max_batch,
+                    max_delay_ms=args.max_delay_ms,
+                    queue_max=args.queue_max)
+    server.start()
+    logger.info("serving on http://%s:%d (POST /v1/predict)",
+                args.host, args.port)
+    try:
+        serve_http(server, host=args.host, port=args.port, block=True)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,6 +1,7 @@
 """Small host-side utilities (parity: reference tensorflowonspark/util.py)."""
 
 from tensorflowonspark_tpu.utils.hostinfo import (  # noqa: F401
+    child_pids_dir,
     clear_child_pids,
     find_in_path,
     get_ip_address,

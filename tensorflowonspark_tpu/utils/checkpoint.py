@@ -210,6 +210,21 @@ def latest_step(ckpt_dir):
     return max(every) if every else None
 
 
+def latest(ckpt_dir):
+    """(step, path) of the newest checkpoint across BOTH formats, or
+    (None, None).  The serving hot-reload watcher
+    (serving/replicas.ReplicaPool) polls this cheaply — it is a listing,
+    never a restore; ``restore_any`` does the actual load."""
+    steps = _steps_by_format(ckpt_dir)
+    best_npz = max(steps["npz"]) if steps["npz"] else -1
+    best_orbax = max(steps["orbax"]) if steps["orbax"] else -1
+    if best_orbax < 0 and best_npz < 0:
+        return None, None
+    if best_orbax >= best_npz:
+        return best_orbax, _fs.join(ckpt_dir, str(best_orbax))
+    return best_npz, _fs.join(ckpt_dir, f"ckpt-{best_npz:08d}.npz")
+
+
 def restore_any(ckpt_dir):
     """(tree, step) from the newest checkpoint regardless of format, or
     (None, 0).  The auto-resume entry point (``TFNodeContext

@@ -55,6 +55,28 @@ def read_executor_id(cwd=None):
 
 
 _CHILD_PIDS_FILE = "tfos_child_pids"
+CHILD_PIDS_DIR_ENV = "TFOS_CHILD_PIDS_DIR"
+
+
+def child_pids_dir():
+    """Default directory of this process's child-pid ledger.
+
+    Executor processes (``TFOS_EXECUTOR_INDEX`` set) keep the original
+    contract — their ledger lives in the executor working dir, where the
+    engine's respawn/stop paths read it.  Any other process (the driver,
+    a serving pool, a test) gets a per-process tempdir instead of its
+    CWD: a driver-side ``manager.start`` used to drop ``tfos_child_pids``
+    into whatever directory the user launched from (the repo root,
+    typically).  ``TFOS_CHILD_PIDS_DIR`` overrides both.
+    """
+    override = os.environ.get(CHILD_PIDS_DIR_ENV)
+    if override:
+        return override
+    if "TFOS_EXECUTOR_INDEX" in os.environ:
+        return os.getcwd()
+    import tempfile
+
+    return os.path.join(tempfile.gettempdir(), f"tfos-pids-{os.getpid()}")
 
 
 def track_child_pid(pid, cwd=None):
@@ -66,8 +88,10 @@ def track_child_pid(pid, cwd=None):
     init and outlive the job.  The pid file lets the engine's ``stop()``
     kill survivors it can no longer reach through a manager.
     """
-    path = os.path.join(cwd or os.getcwd(), _CHILD_PIDS_FILE)
+    base = cwd or child_pids_dir()
+    path = os.path.join(base, _CHILD_PIDS_FILE)
     try:
+        os.makedirs(base, exist_ok=True)
         with open(path, "a") as f:
             f.write(f"{pid}\n")
     except OSError as e:  # best-effort bookkeeping only
@@ -77,7 +101,7 @@ def track_child_pid(pid, cwd=None):
 
 def read_child_pids(cwd=None):
     """Pids recorded by track_child_pid in the given working dir."""
-    path = os.path.join(cwd or os.getcwd(), _CHILD_PIDS_FILE)
+    path = os.path.join(cwd or child_pids_dir(), _CHILD_PIDS_FILE)
     if not os.path.exists(path):
         return []
     try:
@@ -89,9 +113,10 @@ def read_child_pids(cwd=None):
 
 def clear_child_pids(cwd=None):
     """Forget the child pids recorded for ``cwd``.  Called after an
-    executor respawn has reaped the dead incarnation's children, so the
-    replacement's pid file starts clean."""
-    path = os.path.join(cwd or os.getcwd(), _CHILD_PIDS_FILE)
+    executor respawn has reaped the dead incarnation's children (and by
+    engine stop after its final sweep), so the next incarnation's pid
+    file starts clean."""
+    path = os.path.join(cwd or child_pids_dir(), _CHILD_PIDS_FILE)
     try:
         os.remove(path)
     except OSError:

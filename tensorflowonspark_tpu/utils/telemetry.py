@@ -67,6 +67,15 @@ FLUSH_ENV = "TFOS_TELEMETRY_FLUSH"
 
 SCHEMA_KEYS = ("ts", "node_id", "role", "kind", "name", "dur_ms", "attrs")
 
+# -- serving SLO metric names (docs/serving.md) ----------------------------
+# One span per served request with queue_ms / batch_ms / device_ms /
+# batch / bucket attrs; one event per load-shed rejection.  trace_merge
+# summarizes them into p50/p95/p99 and shed-rate.
+SERVE_REQUEST = "serve/request"
+SERVE_SHED = "serve/shed"
+SERVE_BATCH = "serve/replica_batch"   # replica-side device batch span
+SERVE_RELOAD = "serve/reload"         # hot-reload broadcast event
+
 
 class Recorder:
     """Per-process span/event sink: bounded buffer -> one JSONL file."""

@@ -1,0 +1,547 @@
+"""Online-serving tests: micro-batcher units, replica pool + hot reload,
+SLO stats/telemetry, HTTP frontend, and the PR's acceptance smoke
+(64 concurrent clients against 2 replicas; coalescing + one compile per
+shape bucket).  Slow lane: a SIGKILLed replica under load (respawn,
+zero dropped non-shed requests)."""
+
+import json
+import os
+import sys
+import threading
+import time
+import types
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.serving import batcher as B
+from tensorflowonspark_tpu.serving import replicas as R
+from tensorflowonspark_tpu.serving import server as S
+
+pytestmark = pytest.mark.serve
+
+
+# --- probe predicts (module-level: shipped to executor processes) -----------
+
+def _double_predict(params, inputs):
+    x = np.asarray(inputs["x"])
+    return {"y": x * params["scale"]}
+
+
+def _version_predict(params, inputs):
+    x = np.asarray(inputs["x"])
+    time.sleep(0.01)
+    return {"version": np.full(x.shape[0], float(np.asarray(params["version"])))}
+
+
+def _slow_predict(params, inputs):
+    x = np.asarray(inputs["x"])
+    time.sleep(0.05)
+    return {"y": x * 1.0}
+
+
+# --- batcher units ----------------------------------------------------------
+
+def test_bucket_size_pow2_and_cap():
+    assert [B.bucket_size(n, 64) for n in (1, 2, 3, 5, 9, 33, 64, 100)] == \
+        [1, 2, 4, 8, 16, 64, 64, 64]
+    # the cap itself is a legal bucket even when not a power of two
+    assert B.bucket_size(48, 48) == 48
+    assert B.bucket_size(49, 48) == 48
+    assert B.bucket_size(3, 48) == 4
+
+
+def test_pad_rows_edge_replication_and_errors():
+    a = np.arange(6, dtype=np.float32).reshape(3, 2)
+    padded = B.pad_rows(a, 5)
+    assert padded.shape == (5, 2)
+    assert (padded[3] == a[-1]).all() and (padded[4] == a[-1]).all()
+    assert B.pad_rows(a, 3) is a  # no-op returns the input
+    with pytest.raises(ValueError):
+        B.pad_rows(a, 2)  # cannot shrink
+    with pytest.raises(ValueError):
+        B.pad_rows(np.zeros((0, 2)), 4)  # nothing to replicate
+    with pytest.raises(ValueError):
+        B.pad_rows(np.float32(3.0), 4)  # scalar has no batch axis
+
+
+def test_pad_columns_preserves_container():
+    d = B.pad_columns({"a": np.zeros((2, 3)), "b": np.ones((2,))}, 4)
+    assert set(d) == {"a", "b"}
+    assert d["a"].shape == (4, 3) and d["b"].shape == (4,)
+    t = B.pad_columns((np.zeros((3, 1)),), 8)
+    assert isinstance(t, tuple) and t[0].shape == (8, 1)
+
+
+def test_batcher_coalesces_concurrent_requests():
+    batches = []
+    done = threading.Event()
+
+    def dispatch(batch):
+        batches.append(batch)
+        batch.complete({"y": batch.inputs["x"] + 1})
+        if sum(b.n_valid for b in batches) >= 16:
+            done.set()
+
+    mb = B.MicroBatcher(dispatch, max_batch=32, max_delay_ms=50,
+                        queue_max=100)
+    # queue a wave BEFORE starting the batcher thread so the first gather
+    # sees them all at once — deterministic coalescing
+    reqs = [mb.submit({"x": np.full((2,), float(i))}) for i in range(16)]
+    mb.start()
+    results = [r.result(timeout=10) for r in reqs]
+    assert done.wait(5)
+    mb.close()
+    assert len(batches) == 1 and batches[0].n_valid == 16
+    assert batches[0].bucket == 16
+    assert batches[0].inputs["x"].shape == (16, 2)
+    for i, row in enumerate(results):
+        assert (row["y"] == i + 1).all()
+    # timing attrs ride on the resolved request
+    attrs = reqs[0].attrs
+    assert attrs["batch"] == 16 and attrs["bucket"] == 16
+    assert attrs["total_ms"] >= 0 and attrs["queue_ms"] >= 0
+
+
+def test_batcher_deadline_flush_single_request():
+    batches = []
+
+    def dispatch(batch):
+        batches.append(batch)
+        batch.complete({"y": batch.inputs["x"]})
+
+    mb = B.MicroBatcher(dispatch, max_batch=64, max_delay_ms=20,
+                        queue_max=10).start()
+    t0 = time.perf_counter()
+    row = mb.submit({"x": np.ones(3)}).result(timeout=5)
+    waited = time.perf_counter() - t0
+    mb.close()
+    assert row["y"].shape == (3,)
+    # a lone request is padded to bucket 1 and flushed at the deadline,
+    # not held until a batch fills
+    assert batches[0].bucket == 1 and batches[0].n_valid == 1
+    assert waited < 5.0
+
+
+def test_batcher_groups_by_signature():
+    batches = []
+
+    def dispatch(batch):
+        batches.append(batch)
+        batch.complete({"y": batch.inputs["x"]})
+
+    mb = B.MicroBatcher(dispatch, max_batch=32, max_delay_ms=50,
+                        queue_max=100)
+    small = [mb.submit({"x": np.zeros((2,))}) for _ in range(3)]
+    big = [mb.submit({"x": np.zeros((4,))}) for _ in range(5)]
+    mb.start()
+    for r in small + big:
+        r.result(timeout=10)
+    mb.close()
+    shapes = sorted((b.n_valid, b.inputs["x"].shape) for b in batches)
+    assert shapes == [(3, (4, 2)), (5, (8, 4))]
+
+
+def test_batcher_sheds_past_queue_max():
+    sheds = []
+    mb = B.MicroBatcher(lambda b: None, max_batch=8, max_delay_ms=5,
+                        queue_max=2, on_shed=lambda d, l: sheds.append((d, l)))
+    # not started: nothing drains the queue, so depth is deterministic
+    mb.submit({"x": np.ones(1)})
+    mb.submit({"x": np.ones(1)})
+    with pytest.raises(B.Overloaded) as ei:
+        mb.submit({"x": np.ones(1)})
+    assert ei.value.depth >= 2 and ei.value.limit == 2
+    assert ei.value.retry_after >= 0.05
+    assert sheds == [(ei.value.depth, 2)]
+    mb.close()
+
+
+def test_batcher_close_fails_queued_requests():
+    mb = B.MicroBatcher(lambda b: None, queue_max=10)  # never started
+    req = mb.submit({"x": np.ones(1)})
+    mb.close()
+    with pytest.raises(RuntimeError, match="shut down"):
+        req.result(timeout=1)
+    with pytest.raises(RuntimeError, match="closed"):
+        mb.submit({"x": np.ones(1)})
+
+
+def test_batch_resolves_once():
+    req = B.PendingResult({"x": np.ones(1)})
+    batch = B.Batch(1, [req], {"x": np.ones((1, 1))}, 1, 0.0)
+    assert batch.complete({"y": np.array([[1.0]])})
+    assert not batch.complete({"y": np.array([[9.0]])})  # duplicate: no-op
+    assert not batch.fail(RuntimeError("late"))
+    assert (req.result(timeout=1)["y"] == 1.0).all()
+
+
+# --- pipeline partial-batch padding (satellite b) ---------------------------
+
+def test_pipeline_pads_partial_batch(tmp_path):
+    from tensorflowonspark_tpu import pipeline as P
+    from tensorflowonspark_tpu.utils import checkpoint as ckpt
+
+    shapes = []
+    probe = types.ModuleType("_tfos_pad_probe")
+
+    def probe_predict(params, inputs):
+        (x,) = inputs.values()
+        shapes.append(np.asarray(x).shape)
+        return {"out": np.asarray(x).sum(axis=1)}
+
+    probe.predict = probe_predict
+    sys.modules["_tfos_pad_probe"] = probe
+    try:
+        export = str(tmp_path / "export")
+        ckpt.export_model(export, {"w": np.ones(1)},
+                          metadata={"predict": "_tfos_pad_probe:predict"})
+        rows = [(list(map(float, r)),)
+                for r in np.arange(20, dtype=np.float32).reshape(10, 2)]
+        args = P.Namespace({
+            "export_dir": export, "batch_size": 4,
+            "input_mapping": {"features": "x"},
+            "output_mapping": {"out": "s"},
+        })
+        out = P._run_model(args)(iter(rows))
+        # 10 rows / batch 4 -> 4,4,2; the final 2 are padded up to 4 so
+        # the predict only ever sees ONE shape
+        assert set(shapes) == {(4, 2)}
+        assert len(out) == 10  # padded rows sliced back off
+        expect = np.arange(20, dtype=np.float32).reshape(10, 2).sum(axis=1)
+        assert [r["s"] for r in out] == pytest.approx(list(expect))
+
+        # opt-out: --no_pad_partial exposes the ragged final batch
+        shapes.clear()
+        P._model_cache.clear()
+        args_nopad = P.Namespace(dict(args.items(), pad_partial=False))
+        out = P._run_model(args_nopad)(iter(rows))
+        assert (2, 2) in shapes and len(out) == 10
+    finally:
+        del sys.modules["_tfos_pad_probe"]
+        P._model_cache.clear()
+
+
+def test_inference_cli_pad_partial_flag():
+    from tensorflowonspark_tpu import inference
+
+    p = inference.build_parser()
+    base = ["--export_dir", "/e", "--input", "/i", "--output", "/o"]
+    assert p.parse_args(base).pad_partial is True
+    assert p.parse_args(base + ["--no_pad_partial"]).pad_partial is False
+
+
+# --- replica pool: end-to-end numpy service ---------------------------------
+
+def test_server_numpy_predict_roundtrip():
+    spec = R.ModelSpec(predict=_double_predict, params={"scale": 3.0},
+                       jit=False)
+    with S.Server(spec, num_replicas=2, max_batch=8, max_delay_ms=5) as srv:
+        c = srv.client()
+        out = c.predict({"x": np.array([1.0, 2.0], np.float32)}, timeout=60)
+        assert out["y"] == pytest.approx([3.0, 6.0])
+        results = {}
+
+        def burst(i):
+            r = c.predict({"x": np.full((2,), float(i), np.float32)},
+                          timeout=60)
+            results[i] = r["y"]
+
+        ts = [threading.Thread(target=burst, args=(i,)) for i in range(12)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(results) == 12
+        for i, y in results.items():
+            assert y == pytest.approx([3.0 * i] * 2)
+        summ = srv.summary()
+        assert summ["completed"] == 13 and summ["errors"] == 0
+        assert summ["p99_ms"] > 0
+        assert srv.pool.live_replicas() == [0, 1]
+
+
+def test_model_spec_requires_a_model():
+    with pytest.raises(ValueError):
+        R.ModelSpec()
+    # string predict specs resolve without an export_dir
+    spec = R.ModelSpec(predict="tensorflowonspark_tpu.models.mnist:predict")
+    assert spec.predict.endswith(":predict")
+
+
+# --- checkpoint hot-reload (satellite c) ------------------------------------
+
+def test_checkpoint_hot_reload(tmp_path, monkeypatch):
+    from tensorflowonspark_tpu.utils import checkpoint as ckpt
+
+    ckpt_dir = str(tmp_path / "ckpts")
+    ckpt.save_checkpoint(ckpt_dir, {"version": np.array(1.0)}, step=1)
+    assert ckpt.latest(ckpt_dir)[0] == 1
+    monkeypatch.setenv("TFOS_SERVE_RELOAD_SECS", "0.2")
+    spec = R.ModelSpec(predict=_version_predict, ckpt_dir=ckpt_dir,
+                       jit=False)
+    with S.Server(spec, num_replicas=2, max_batch=8, max_delay_ms=5) as srv:
+        c = srv.client()
+        first = [c.predict({"x": np.ones(1, np.float32)}, timeout=60)
+                 for _ in range(4)]
+        # per-request rows are sliced from the (n,) column -> scalars
+        assert all(float(r["version"]) == 1.0 for r in first)
+        assert set(srv.pool.versions().values()) == {1}
+
+        ckpt.save_checkpoint(ckpt_dir, {"version": np.array(2.0)}, step=2)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if set(srv.pool.versions().values()) == {2}:
+                break
+            time.sleep(0.1)
+        assert set(srv.pool.versions().values()) == {2}, srv.pool.versions()
+        # requests after the ack see the new params on every replica
+        later = [c.predict({"x": np.ones(1, np.float32)}, timeout=60)
+                 for _ in range(4)]
+        assert all(float(r["version"]) == 2.0 for r in later)
+
+
+# --- acceptance smoke: coalescing + compile-per-bucket under load -----------
+
+def test_acceptance_smoke_64_clients_2_replicas(tmp_path, monkeypatch):
+    """ISSUE acceptance: 2-replica CPU service, 64 concurrent in-process
+    clients; batcher demonstrably coalesces (mean device batch > 4),
+    exactly one compile per shape bucket, SLO telemetry emitted and
+    summarized by trace_merge."""
+    import jax
+
+    from tensorflowonspark_tpu.models import mnist
+    from tensorflowonspark_tpu.utils import checkpoint as ckpt
+    from tensorflowonspark_tpu.utils import telemetry
+
+    tdir = str(tmp_path / "telemetry")
+    monkeypatch.setenv("TFOS_TELEMETRY_DIR", tdir)
+    # a prior test's spool override would silently reroute our spans
+    monkeypatch.delenv("TFOS_TELEMETRY_SPOOL", raising=False)
+    telemetry.configure(node_id="driver", role="driver")
+
+    export = str(tmp_path / "export")
+    ckpt.export_model(export, mnist.init_params(jax.random.PRNGKey(0)),
+                      metadata={
+        "predict": "tensorflowonspark_tpu.models.mnist:serve_predict",
+    })
+    spec = R.ModelSpec(export_dir=export)
+    rng = np.random.default_rng(0)
+    images = rng.random((64, 28, 28, 1)).astype(np.float32)
+    with S.Server(spec, num_replicas=2, max_batch=32,
+                  max_delay_ms=10) as srv:
+        c = srv.client()
+        # warmup (jax import + first compiles happen here)
+        for _ in range(2):
+            c.predict({"image": images[0]}, timeout=300)
+        errors = []
+
+        def burst(i):
+            try:
+                out = c.predict({"image": images[i]}, timeout=300)
+                assert out["logits"].shape == (10,)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        ts = [threading.Thread(target=burst, args=(i,)) for i in range(64)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errors, errors[:3]
+        summ = srv.summary(include_replicas=True)
+        telemetry.flush()
+
+    assert summ["completed"] == 66 and summ["shed"] == 0
+    # coalescing: 64 near-simultaneous requests must form real batches
+    assert summ["mean_device_batch"] > 4, summ
+    # every bucket is a power of two (or the cap)
+    for b in summ["buckets"]:
+        assert b == 32 or (b & (b - 1)) == 0, summ["buckets"]
+    assert summ["p99_ms"] > 0 and summ["p50_ms"] > 0
+    # exactly one jit compile per (replica, shape bucket): the AOT
+    # compile-count hook increments once per first-seen signature
+    total_compiles = 0
+    for st in summ["replica_stats"].values():
+        for sig, count in st["compiles"].items():
+            assert count == 1, (sig, st["compiles"])
+            total_compiles += count
+    n_buckets_seen = len(summ["buckets"])
+    assert 0 < total_compiles <= 2 * n_buckets_seen
+
+    # the telemetry spool carries serve/request spans; trace_merge
+    # summarizes them into the serving SLO section
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                    "scripts"))
+    try:
+        import trace_merge
+    finally:
+        sys.path.pop(0)
+    pairs, _skipped = trace_merge.load_records(tdir)
+    text, stats = trace_merge.summarize(pairs)
+    assert stats["serving"]["requests"] >= 66
+    assert "-- serving" in text
+    assert stats["serving"]["p99_ms"] > 0
+
+
+# --- HTTP frontend ----------------------------------------------------------
+
+class _StubPool:
+    def live_replicas(self):
+        return [0]
+
+    def versions(self):
+        return {0: 0}
+
+
+class _ShedStub:
+    pool = _StubPool()
+
+    def predict(self, example, timeout=None):
+        raise B.Overloaded(5, 4, retry_after=0.25)
+
+    def summary(self, include_replicas=False):
+        return {"requests": 0}
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, dict(resp.headers), json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+def test_http_overload_maps_to_503_retry_after():
+    httpd = S.serve_http(_ShedStub(), port=0, block=False)
+    try:
+        host, port = httpd.server_address
+        code, headers, body = _post(
+            f"http://{host}:{port}/v1/predict", {"inputs": {"x": [1.0]}})
+        assert code == 503
+        assert body["error"] == "overloaded"
+        assert float(headers["Retry-After"]) == pytest.approx(0.25)
+        # malformed body -> 400, not a crash
+        code, _, body = _post(f"http://{host}:{port}/v1/predict",
+                              {"nope": 1})
+        assert code == 400
+    finally:
+        httpd.shutdown()
+
+
+def test_http_predict_and_health_roundtrip():
+    spec = R.ModelSpec(predict=_double_predict, params={"scale": 2.0},
+                       jit=False)
+    with S.Server(spec, num_replicas=1, max_batch=4, max_delay_ms=5) as srv:
+        httpd = S.serve_http(srv, port=0, block=False)
+        try:
+            host, port = httpd.server_address
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/healthz") as resp:
+                assert resp.status == 200
+                assert json.loads(resp.read())["status"] == "ok"
+            code, _, body = _post(
+                f"http://{host}:{port}/v1/predict",
+                {"inputs": {"x": [1.0, 2.0, 3.0]}})
+            assert code == 200
+            assert body["outputs"]["y"] == pytest.approx([2.0, 4.0, 6.0])
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/stats") as resp:
+                stats = json.loads(resp.read())
+            assert stats["completed"] >= 1
+        finally:
+            httpd.shutdown()
+
+
+def test_serve_cli_parser():
+    p = S.build_parser()
+    args = p.parse_args(["--export_dir", "/e", "--port", "9000"])
+    assert args.export_dir == "/e" and args.port == 9000
+    assert args.num_replicas is None
+
+
+# --- child-pid ledger satellite (a) -----------------------------------------
+
+def test_manager_start_keeps_cwd_clean(tmp_path, monkeypatch):
+    """Regression: driver-side manager.start used to drop tfos_child_pids
+    into the launch CWD (the repo root, typically)."""
+    from tensorflowonspark_tpu import manager as tfmanager
+    from tensorflowonspark_tpu.utils import hostinfo
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.delenv("TFOS_EXECUTOR_INDEX", raising=False)
+    monkeypatch.delenv(hostinfo.CHILD_PIDS_DIR_ENV, raising=False)
+    mgr = tfmanager.start(b"test-key-serving", ["q"])
+    try:
+        assert not (tmp_path / "tfos_child_pids").exists()
+        pids = hostinfo.read_child_pids()  # ledger in the tempdir default
+        assert pids, "manager server pid should be tracked"
+    finally:
+        mgr.shutdown()
+        hostinfo.clear_child_pids()
+
+
+def test_child_pids_dir_override_and_executor_contract(tmp_path, monkeypatch):
+    from tensorflowonspark_tpu.utils import hostinfo
+
+    monkeypatch.setenv(hostinfo.CHILD_PIDS_DIR_ENV, str(tmp_path / "ovr"))
+    assert hostinfo.child_pids_dir() == str(tmp_path / "ovr")
+    monkeypatch.delenv(hostinfo.CHILD_PIDS_DIR_ENV)
+    # executors keep the original working-dir contract
+    monkeypatch.setenv("TFOS_EXECUTOR_INDEX", "0")
+    monkeypatch.chdir(tmp_path)
+    assert hostinfo.child_pids_dir() == str(tmp_path)
+    monkeypatch.delenv("TFOS_EXECUTOR_INDEX")
+    assert "tfos-pids-" in hostinfo.child_pids_dir()
+
+
+# --- slow lane: replica SIGKILL under load (satellite e) --------------------
+
+@pytest.mark.slow
+def test_replica_sigkill_respawn_zero_drop():
+    """A 2-replica service survives one SIGKILLed replica under load:
+    the engine respawns it, orphaned batches are re-dispatched, and no
+    non-shed request is dropped."""
+    spec = R.ModelSpec(predict=_slow_predict, params={}, jit=False)
+    with S.Server(spec, num_replicas=2, max_batch=8, max_delay_ms=5,
+                  queue_max=10_000) as srv:
+        c = srv.client()
+        c.predict({"x": np.ones(2, np.float32)}, timeout=60)  # warm
+        victim = srv.pool.replica_pids()[0]
+        results, errors = [], []
+
+        def burst(i):
+            for j in range(10):
+                try:
+                    r = c.predict(
+                        {"x": np.full((2,), float(i), np.float32)},
+                        timeout=120)
+                    results.append((i, r["y"]))
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+        ts = [threading.Thread(target=burst, args=(i,)) for i in range(16)]
+        for t in ts:
+            t.start()
+        time.sleep(0.3)  # let batches land on both replicas
+        os.kill(victim, 9)
+        for t in ts:
+            t.join()
+        assert not errors, errors[:3]
+        assert len(results) == 160
+        for i, y in results:
+            assert y == pytest.approx([float(i)] * 2)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if (srv.pool.respawns_observed >= 1
+                    and srv.pool.live_replicas() == [0, 1]):
+                break
+            time.sleep(0.2)
+        assert srv.pool.respawns_observed >= 1
+        assert srv.pool.live_replicas() == [0, 1]
+        summ = srv.summary()
+        assert summ["errors"] == 0 and summ["completed"] >= 161
