@@ -144,6 +144,7 @@ def summarize(pairs, skipped=0):
     per_node = {}
     serve = {"totals_ms": [], "queue_ms": [], "device_ms": [],
              "batches": [], "shed": 0}
+    data_stages = {}
     for rec in recs:
         node = per_node.setdefault(
             rec["node_id"],
@@ -170,6 +171,13 @@ def summarize(pairs, skipped=0):
                 node["peak_flops"] = float(attrs["peak_flops"])
         elif rec["name"] == "feed/wait":
             node["infeed_s"] += float(rec["dur_ms"]) / 1e3
+        elif rec["name"] == "data/stage":
+            st = data_stages.setdefault(
+                str(attrs.get("stage") or "?"),
+                {"self_ms": [], "wait_ms": [], "records": 0})
+            st["self_ms"].append(float(rec["dur_ms"]))
+            st["wait_ms"].append(float(attrs.get("wait_ms") or 0.0))
+            st["records"] += int(attrs.get("records") or 0)
         elif rec["name"] == "serve/request":
             serve["totals_ms"].append(float(rec["dur_ms"]))
             if attrs.get("queue_ms") is not None:
@@ -229,6 +237,40 @@ def summarize(pairs, skipped=0):
             f"mean queue={s['mean_queue_ms']:.1f}ms "
             f"device={s['mean_device_ms']:.1f}ms "
             f"device batch={s['mean_device_batch']:.1f}")
+
+    if data_stages:
+        # input-pipeline stall attribution (docs/data.md): each
+        # data/stage span is one produced block — dur_ms is the stage's
+        # own produce time, attrs.wait_ms the time it blocked on its
+        # upstream.  stall = wait / (wait + self): ~1.0 means the stage
+        # starves (upstream-bound), ~0.0 means it is the bottleneck.
+        stats["data"] = {}
+        lines.append("")
+        lines.append("-- data (data/stage spans) --")
+        lines.append(
+            f"{'stage':<16} {'blocks':>7} {'records':>9} {'self_p50':>9} "
+            f"{'self_p95':>9} {'wait_p50':>9} {'wait_p95':>9} {'stall':>6}")
+        for name in sorted(data_stages):
+            st = data_stages[name]
+            selfs = sorted(st["self_ms"])
+            waits = sorted(st["wait_ms"])
+            tot_self = sum(selfs)
+            tot_wait = sum(waits)
+            loop = tot_self + tot_wait
+            stats["data"][name] = {
+                "blocks": len(selfs), "records": st["records"],
+                "self_p50_ms": _pct(selfs, 0.50),
+                "self_p95_ms": _pct(selfs, 0.95),
+                "wait_p50_ms": _pct(waits, 0.50),
+                "wait_p95_ms": _pct(waits, 0.95),
+                "stall_frac": tot_wait / loop if loop else 0.0,
+            }
+            d = stats["data"][name]
+            lines.append(
+                f"{name:<16} {d['blocks']:>7} {d['records']:>9} "
+                f"{d['self_p50_ms']:>9.2f} {d['self_p95_ms']:>9.2f} "
+                f"{d['wait_p50_ms']:>9.2f} {d['wait_p95_ms']:>9.2f} "
+                f"{d['stall_frac']:>6.2f}")
 
     lines.append("")
     lines.append("-- per-node train steps --")

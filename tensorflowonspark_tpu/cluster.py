@@ -77,6 +77,11 @@ class TFCluster:
         """
         logger.info("feeding training data")
         assert self.input_mode == InputMode.SPARK, "train() requires InputMode.SPARK"
+        if hasattr(dataset, "_blocks") and hasattr(dataset, "chunks"):
+            # a data.Pipeline: serve it through the disaggregated data
+            # service (docs/data.md) instead of per-partition feeders
+            return self._train_data_service(dataset, num_epochs,
+                                            feed_timeout, qname)
         if hasattr(dataset, "foreachRDD"):
             # Spark Streaming DStream (parity: TFCluster.py:83-85): every
             # micro-batch RDD's partitions are fed through the same
@@ -110,6 +115,44 @@ class TFCluster:
             try:
                 ds.foreach_partition(feeder, spread=True,
                                      retryable=self.restarts > 0)
+                return
+            except (engine_mod.TaskError, RuntimeError, TimeoutError) as e:
+                if self._restarts_used >= self.restarts:
+                    raise
+                self._recover(e)
+
+    def _train_data_service(self, pipeline, num_epochs, feed_timeout,
+                            qname):
+        """Feed trainers from a ``data.Pipeline`` via the data service:
+        ``data_workers`` engine tasks each run the pipeline and push its
+        per-trainer shard over the feed wire (data/service.py).  The
+        same supervision contract as feeder-mode ``train()``: a task or
+        worker failure triggers recovery up to ``restarts`` times, and
+        re-served streams resume at the per-trainer unit ledger instead
+        of re-feeding consumed data."""
+        from tensorflowonspark_tpu.data import service as data_service
+
+        n_workers = int(self.meta.get("data_workers") or
+                        data_service.default_workers())
+        assert num_epochs >= 0, "num_epochs cannot be negative"
+        if num_epochs > 1:
+            pipeline = pipeline.repeat(num_epochs)
+        # this job's unit ledgers start empty (cf. reset_feed in train())
+        for rank, _m in data_service.trainer_ranks(self.cluster_info):
+            self.server.reset_feed(data_service.ledger_feed(qname, rank))
+        logger.info("data service: %d worker task(s) feeding %d trainers",
+                    n_workers,
+                    len(data_service.trainer_ranks(self.cluster_info)))
+        while True:
+            fn = data_service.serve_task(
+                pipeline, self.cluster_info, self.cluster_meta,
+                qname=qname, num_workers=n_workers,
+                feed_timeout=feed_timeout)
+            try:
+                self.engine.parallelize(
+                    list(range(n_workers)), n_workers
+                ).foreach_partition(fn, spread=True,
+                                    retryable=self.restarts > 0)
                 return
             except (engine_mod.TaskError, RuntimeError, TimeoutError) as e:
                 if self._restarts_used >= self.restarts:
@@ -466,6 +509,7 @@ def run(
     num_chips=0,
     background=None,
     restarts=0,
+    data_workers=0,
 ):
     """Starts the distributed cluster (parity: TFCluster.run :215-383).
 
@@ -479,6 +523,11 @@ def run(
     driver's observation point; TENSORFLOW-mode jobs (nodes read their
     own data) and streaming feeds are not auto-restarted (see
     docs/fault_tolerance.md).
+
+    ``data_workers``: number of dedicated data-service tasks used when
+    ``train()`` is given a ``data.Pipeline`` instead of a dataset
+    (docs/data.md); 0 defers to ``TFOS_DATA_WORKERS`` (default 1) at
+    ``train()`` time.
     """
     logger.info("Reserving TFSparkNodes-TPU")
     start_t0 = time.perf_counter()
@@ -533,6 +582,7 @@ def run(
         "server_addr": list(server_addr),
         "authkey": secrets.token_hex(16),
         "reservation_timeout": reservation_timeout,
+        "data_workers": int(data_workers),
     }
 
     tf_status.clear()

@@ -1,0 +1,58 @@
+"""Composable input-pipeline subsystem: lazy dataset graph + data service.
+
+Parity target: reference ``tensorflowonspark/TFNode.py:221-329`` (the
+DataFeed bridge) plus the tf.data recipes hard-coded in the examples
+(``examples/mnist/keras/mnist_spark.py:33-66``: shuffle/batch/prefetch
+between DataFeed and model.fit).  The reference delegates all pipeline
+*structure* to tf.data and only owns the Spark↔TF hop; here the whole
+graph is owned: sources -> transforms -> device staging, with the
+columnar chunk wire (``marker.ColumnChunk``) as the zero-copy leaf
+format, and a disaggregated data-service mode
+(:class:`~tensorflowonspark_tpu.data.service.DataService`) that scales
+preprocessing independently of trainers (PAPERS.md: tf.data,
+arxiv 2101.12127; tf.data service disaggregation).
+
+Quick start::
+
+    from tensorflowonspark_tpu import data
+
+    pipe = (data.from_tfrecords("/data/train")
+                .interleave(cycle_length=4)
+                .shuffle(buffer_size=10_000, seed=42)
+                .parallel_map(normalize, num_workers=4)
+                .batch(256, drop_remainder=True)
+                .prefetch(2))
+    for block in pipe.blocks():          # host: {name: ndarray[b, ...]}
+        ...
+    for staged in pipe.to_device():      # device: double-buffered staging
+        ...
+
+Service mode (``cluster.run(..., data_workers=N)``)::
+
+    cluster = TFCluster.run(sc, main_fun, args, num_executors,
+                            input_mode=InputMode.SPARK, data_workers=2)
+    cluster.train(pipe, num_epochs=4)    # N executors run the pipeline
+
+Knobs: ``TFOS_DATA_WORKERS`` (default service worker count),
+``TFOS_DATA_PREFETCH`` (default prefetch depth), see docs/data.md.
+"""
+
+from tensorflowonspark_tpu.data.pipeline import (  # noqa: F401
+    Pipeline,
+    block_len,
+    block_to_chunk,
+    from_arrays,
+    from_dataset,
+    from_tfrecords,
+)
+from tensorflowonspark_tpu.data.service import DataService  # noqa: F401
+
+__all__ = [
+    "Pipeline",
+    "DataService",
+    "from_tfrecords",
+    "from_dataset",
+    "from_arrays",
+    "block_to_chunk",
+    "block_len",
+]

@@ -1,0 +1,316 @@
+"""Disaggregated data service: dedicated executors run the pipeline and
+feed trainers over the manager/shm wire.
+
+Parity target: the reference's feeding plane — ``TFSparkNode.train``
+(reference ``TFSparkNode.py:448-515``, the per-partition feeder loop with
+await-consumption) and its ledgered exactly-once recovery — generalized
+from "one Spark partition per feeder task" to "N long-lived data workers
+each serving a deterministic shard of a composable pipeline" (the
+tf.data-service disaggregation, PAPERS.md arxiv 2101.12127).
+
+Topology: ``cluster.run(..., data_workers=N)`` keeps the trainer cluster
+unchanged and launches N *service tasks* on the engine.  Trainers (the
+compute jobs of ``cluster_info``) are ranked 0..T-1; worker ``j`` serves
+every trainer with ``rank % N == j``.  Each trainer's stream is the
+pipeline sharded ``shard(rank, T)`` — the strided exactly-once split —
+converted to ``marker.ColumnChunk`` wire chunks and pushed through the
+SAME transport handshake as the feeder path (``feed.open_feed_ring``:
+shm ring when advertised, manager queue otherwise), with the same
+backpressure discipline: a put blocked on a full ring re-checks the
+consumer state and heartbeat every second, so a dead trainer fails the
+worker fast instead of wedging it.
+
+Exactly-once accounting rides the existing PDONE/PQUERY feed ledger
+(``rendezvous.Client.partition_done`` / ``fed_partitions``), keyed per
+trainer as ``"<qname>:data:<rank>"``: the stream is cut into **units**
+of ``unit_blocks`` consecutive blocks and a unit is recorded done only
+after every chunk of it was pushed AND the handoff is consumption-safe.
+A killed worker (``TFOS_FAULT_PLAN="data.serve:kill"``; the engine's
+``retryable`` supervision respawns the task) queries the ledger and
+resumes at its shard cursor — the first un-done unit — by recomputing
+and skipping, which the pipeline determinism contract makes exact.  A
+unit interrupted mid-push is re-pushed whole (duplicates bounded by one
+unit), the same at-least-once-within/exactly-once-across granularity the
+reference had per Spark partition.
+
+End-of-feed stays owned by ``cluster.shutdown`` (``node.shutdown``
+pushes the terminal ``None``), exactly as in feeder mode.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+from tensorflowonspark_tpu import rendezvous
+from tensorflowonspark_tpu.utils import faults, telemetry
+
+logger = logging.getLogger(__name__)
+
+WORKERS_ENV = "TFOS_DATA_WORKERS"
+
+
+def trainer_ranks(cluster_info):
+    """[(rank, node_meta)] for the feedable compute nodes of a cluster,
+    rank-ordered by executor id (the stable order both the service and
+    the shard split key on)."""
+    from tensorflowonspark_tpu import node as tfnode
+
+    metas = sorted(
+        (m for m in cluster_info if m["job_name"] in tfnode.COMPUTE_JOBS),
+        key=lambda m: m["executor_id"])
+    return list(enumerate(metas))
+
+
+def ledger_feed(qname, rank):
+    """The per-trainer feed-ledger key (PDONE/PQUERY namespace)."""
+    return f"{qname}:data:{rank}"
+
+
+class DataService:
+    """One data worker's serving loop (see module docstring).
+
+    ``run()`` serves every assigned trainer round-robin — one bounded
+    push attempt each per round — so a trainer with a full ring never
+    starves its siblings, and returns when every assigned stream is
+    fully pushed and consumed.
+    """
+
+    def __init__(self, pipeline, cluster_info, cluster_meta, qname="input",
+                 num_workers=1, worker_index=0, unit_blocks=8,
+                 feed_timeout=600):
+        if not 0 <= worker_index < num_workers:
+            raise ValueError(
+                f"need 0 <= worker_index < num_workers, "
+                f"got {worker_index}/{num_workers}")
+        self.pipeline = pipeline
+        self.cluster_info = cluster_info
+        self.cluster_meta = cluster_meta
+        self.qname = qname
+        self.num_workers = int(num_workers)
+        self.worker_index = int(worker_index)
+        self.unit_blocks = max(1, int(unit_blocks))
+        self.feed_timeout = feed_timeout
+
+    # -- per-trainer stream state -----------------------------------------
+
+    class _Stream:
+        __slots__ = ("rank", "meta", "mgr", "ring", "queue", "equeue",
+                     "chunks", "unit", "unit_off", "pending", "pushed",
+                     "done", "client_done")
+
+        def __init__(self, rank, meta):
+            self.rank = rank
+            self.meta = meta
+            self.mgr = None
+            self.ring = None
+            self.queue = None
+            self.equeue = None
+            self.chunks = None
+            self.unit = 0        # current unit index
+            self.unit_off = 0    # blocks pushed within the current unit
+            self.pending = None  # chunk that timed out on a full ring
+            self.pushed = 0      # records pushed (telemetry)
+            self.done = False    # stream exhausted and consumption-safe
+            self.client_done = False  # trainer went terminating/stopped
+
+    def _open(self, st, client):
+        """Connect to the trainer's manager, resolve the resume cursor
+        from the ledger, and open the sharded chunk stream."""
+        from tensorflowonspark_tpu import node as tfnode
+
+        st.mgr = tfnode._get_manager(
+            self.cluster_info, st.meta["host"], st.meta["executor_id"])
+        telemetry.register_with(st.mgr)
+        state = str(st.mgr.get("state"))
+        if state in ("terminating", "stopped"):
+            logger.info("data worker %d: trainer %d state=%s, skipping",
+                        self.worker_index, st.rank, state)
+            st.client_done = st.done = True
+            return
+        st.ring = tfnode._open_feed_ring(st.mgr, self.qname)
+        st.queue = (None if st.ring is not None
+                    else st.mgr.get_queue(self.qname))
+        st.equeue = st.mgr.get_queue("error")
+        consumed = ()
+        try:
+            consumed = client.fed_partitions(ledger_feed(self.qname, st.rank))
+        except Exception as e:  # noqa: BLE001 - no ledger in standalone use
+            logger.debug("data worker: no feed ledger (%s)", e)
+        done = set(consumed)
+        while st.unit in done:
+            st.unit += 1
+        skip = st.unit * self.unit_blocks
+        if skip:
+            logger.info(
+                "data worker %d: trainer %d resumes at unit %d "
+                "(skipping %d blocks already consumed)",
+                self.worker_index, st.rank, st.unit, skip)
+            telemetry.event("data/serve_resume", trainer=st.rank,
+                            unit=st.unit, skip_blocks=skip)
+        n_trainers = len(trainer_ranks(self.cluster_info))
+        st.chunks = self.pipeline.shard(st.rank, n_trainers).chunks(
+            skip_blocks=skip)
+
+    def _push(self, st, chunk):
+        """One bounded push attempt; returns True when the chunk landed.
+        False means the ring stayed full for the slice — the caller
+        round-robins on.  Raises when the trainer errored or died."""
+        from tensorflowonspark_tpu import node as tfnode
+
+        if st.ring is not None:
+            try:
+                st.ring.put(chunk, timeout_ms=1000)
+                return True
+            except TimeoutError:
+                if str(st.mgr.get("state")) == "terminating":
+                    st.client_done = True
+                    return True  # consumer stopped draining: drop + finish
+                tfnode._raise_if_consumer_lost(st.mgr, st.equeue)
+                return False
+        st.queue.put(chunk, block=True)
+        return True
+
+    def _advance(self, st, client):
+        """Push up to one unit boundary for one trainer; updates the
+        ledger when a unit completes."""
+        from tensorflowonspark_tpu import node as tfnode
+
+        if st.pending is None:
+            if st.unit_off == 0:
+                faults.check("data.serve", worker=self.worker_index,
+                             trainer=st.rank, unit=st.unit)
+            nxt = next(st.chunks, None)
+            if nxt is None:
+                # stream exhausted: the final (short) unit is recorded
+                # done only after the trainer drained it, so a crash in
+                # this window re-pushes instead of losing the tail
+                if st.ring is not None:
+                    tfnode._await_consumption(
+                        st.mgr, lambda: st.ring.qsize_bytes() > 0,
+                        self.feed_timeout, poll=0.2)
+                if st.unit_off and not st.client_done:
+                    self._record_done(st, client)
+                st.done = True
+                return
+            st.pending = nxt
+        chunk = st.pending
+        if not self._push(st, chunk):
+            return  # ring full: retry next round
+        st.pending = None
+        if st.client_done:
+            st.done = True
+            return
+        st.pushed += len(chunk)
+        st.unit_off += 1
+        if st.unit_off >= self.unit_blocks:
+            # exactly-once barrier: a unit enters the ledger only after
+            # the trainer drained it from the ring.  Recording on push
+            # would lose the whole in-flight window when a recovery
+            # tears down the trainer manager (ring contents die with
+            # it) — the resumed worker would skip data nobody trained
+            # on.  Amortized over unit_blocks; raises if the trainer
+            # died, which routes into the engine retry path.
+            if st.ring is not None:
+                tfnode._await_consumption(
+                    st.mgr, lambda: st.ring.qsize_bytes() > 0,
+                    self.feed_timeout, poll=0.2)
+            self._record_done(st, client)
+            st.unit += 1
+            st.unit_off = 0
+
+    def _record_done(self, st, client):
+        try:
+            client.partition_done(ledger_feed(self.qname, st.rank), st.unit)
+        except Exception as e:  # noqa: BLE001 - accounting only
+            logger.warning("data worker: could not record unit %d for "
+                           "trainer %d: %s", st.unit, st.rank, e)
+
+    def run(self):
+        """Serve all assigned trainers to completion; returns a summary
+        dict {trainer_rank: records_pushed}."""
+        assigned = [DataService._Stream(r, m)
+                    for r, m in trainer_ranks(self.cluster_info)
+                    if r % self.num_workers == self.worker_index]
+        if not assigned:
+            logger.info("data worker %d: no trainers assigned (of %d "
+                        "workers)", self.worker_index, self.num_workers)
+            return {}
+        client = None
+        try:
+            client = rendezvous.Client(self.cluster_meta["server_addr"])
+        except Exception as e:  # noqa: BLE001 - standalone use, no ledger
+            logger.debug("data worker: rendezvous unavailable (%s)", e)
+            client = _NullClient()
+        t0 = time.perf_counter()
+        try:
+            for st in assigned:
+                self._open(st, client)
+            while not all(st.done for st in assigned):
+                for st in assigned:
+                    if not st.done:
+                        self._advance(st, client)
+        finally:
+            for st in assigned:
+                if st.ring is not None:
+                    try:
+                        st.ring.close()
+                    except Exception:  # noqa: BLE001 - teardown
+                        pass
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001 - teardown
+                pass
+        summary = {st.rank: st.pushed for st in assigned}
+        telemetry.record_span(
+            "data/serve", time.perf_counter() - t0,
+            worker=self.worker_index,
+            trainers=[st.rank for st in assigned],
+            records=sum(summary.values()))
+        logger.info("data worker %d served %s", self.worker_index, summary)
+        return summary
+
+
+class _NullClient:
+    """Ledger stand-in when no rendezvous server is reachable
+    (standalone DataService use in tests/benches)."""
+
+    def fed_partitions(self, feed):
+        return []
+
+    def partition_done(self, feed, part):
+        pass
+
+    def close(self):
+        pass
+
+
+def default_workers():
+    """Worker count default: ``TFOS_DATA_WORKERS`` (1)."""
+    try:
+        return max(1, int(os.environ.get(WORKERS_ENV, "1")))
+    except ValueError:
+        return 1
+
+
+def serve_task(pipeline, cluster_info, cluster_meta, qname="input",
+               num_workers=1, unit_blocks=8, feed_timeout=600):
+    """Engine closure running one data worker per partition
+    (``engine.parallelize(range(N), N).foreach_partition(...)``).  The
+    worker index comes from the partition's element (falling back to the
+    engine-exported ``TFOS_PARTITION_INDEX`` for respawned retries)."""
+
+    def _serve(iterator):
+        items = list(iterator)
+        if items:
+            widx = int(items[0])
+        else:
+            widx = int(os.environ.get("TFOS_PARTITION_INDEX", "0"))
+        svc = DataService(
+            pipeline, cluster_info, cluster_meta, qname=qname,
+            num_workers=num_workers, worker_index=widx,
+            unit_blocks=unit_blocks, feed_timeout=feed_timeout)
+        svc.run()
+
+    return _serve

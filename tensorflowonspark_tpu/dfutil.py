@@ -138,6 +138,15 @@ def _write_shard(rows, path):
             w.write(to_example(row))
 
 
+def part_files(input_dir):
+    """Public shard list for a TFRecord dir (or a single file path):
+    sorted ``part-*`` files, ``.tmp`` spill excluded.  The shard
+    enumeration contract shared by ``load_tfrecords*``,
+    ``iter_tfrecords_columnar`` and ``data.from_tfrecords`` (whose
+    ``interleave`` opens these files round-robin)."""
+    return _part_files(input_dir)
+
+
 def _part_files(input_dir):
     """Shard list for a TFRecord dir (or a single file path)."""
     files = sorted(

@@ -122,6 +122,7 @@ class DataFeed:
         # slices, so terminate() from another thread can always interleave.
         self._lock = threading.Lock()
         self._stop_requested = False
+        self._wait_acc = 0.0  # feed-wait seconds inside the current pull
         self._queue = None  # cached manager queue proxy (compat path)
         # shm fast path; the handshake (open_feed_ring) is shared with the
         # producer closures so both sides always agree on the transport
@@ -175,6 +176,7 @@ class DataFeed:
             # and the telemetry span), so the stall fractions they report
             # agree by construction.
             dt = time.perf_counter() - t0
+            self._wait_acc += dt
             if self.metrics is not None:
                 self.metrics.infeed_wait(dt)
             if telemetry.enabled():
@@ -189,6 +191,22 @@ class DataFeed:
                 telemetry.record_span("feed/wait", dt, **attrs)
         return chunk
 
+    def _consumer_span(self, t0, out):
+        """Per-pull ``data/stage`` span (stage ``fed_consumer``): the
+        pull's wall time minus the transport wait accumulated by
+        ``_get_chunk`` is the consumer's own assembly (slice/concat/
+        stack) cost — the decomposition ``trace_merge``'s ``-- data --``
+        section reports alongside the pipeline stages."""
+        dur = time.perf_counter() - t0
+        wait = min(self._wait_acc, dur)
+        if isinstance(out, dict):
+            n = len(next(iter(out.values()))) if out else 0
+        else:
+            n = len(out)
+        telemetry.record_span("data/stage", max(dur - wait, 0.0),
+                              stage="fed_consumer",
+                              wait_ms=round(wait * 1e3, 3), records=n)
+
     def next_batch(self, batch_size):
         """Gather up to ``batch_size`` records (TFNode.py:243-288).
 
@@ -197,6 +215,15 @@ class DataFeed:
         queue means end-of-feed; an ``EndPartition`` marker ends the batch
         early in inference mode so results stay partition-aligned.
         """
+        if telemetry.enabled():
+            t0 = time.perf_counter()
+            self._wait_acc = 0.0
+            out = self._next_batch(batch_size)
+            self._consumer_span(t0, out)
+            return out
+        return self._next_batch(batch_size)
+
+    def _next_batch(self, batch_size):
         logger.debug("next_batch(%d) invoked", batch_size)
         tensors = (
             [] if self.input_tensors is None else {t: [] for t in self.input_tensors}
@@ -308,6 +335,15 @@ class DataFeed:
         """
         if self.input_tensors is None:
             raise ValueError("next_batch_columns requires input_mapping")
+        if telemetry.enabled():
+            t0 = time.perf_counter()
+            self._wait_acc = 0.0
+            out = self._next_batch_columns(batch_size)
+            self._consumer_span(t0, out)
+            return out
+        return self._next_batch_columns(batch_size)
+
+    def _next_batch_columns(self, batch_size):
         import numpy as np
 
         segments = {t: [] for t in self.input_tensors}

@@ -227,9 +227,17 @@ def tfrecord_device_feed(source, batch_size, *, collate=None, depth=2,
     shapes.  ``source`` is a dir, file, or this worker's shard subset.
     """
     from tensorflowonspark_tpu import dfutil
+    from tensorflowonspark_tpu.utils import telemetry
 
     it = dfutil.iter_tfrecords_columnar(source, batch_size,
                                         drop_remainder=drop_remainder)
+    if telemetry.enabled():
+        # per-batch data/stage spans (stage tfrecord_read): decode/IO
+        # cost of this hot path lands in trace_merge's -- data -- stall
+        # table next to the pipeline stages (docs/data.md)
+        from tensorflowonspark_tpu.data.pipeline import _instrumented
+
+        it = _instrumented("tfrecord_read", it)
     if collate is not None:
         it = map(collate, it)
     return prefetch_to_device(it, depth=depth, placement=placement)

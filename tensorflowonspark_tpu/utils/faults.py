@@ -65,6 +65,7 @@ SITES = (
     "node.main",            # node.py wrapper_fn, before user main_fun
     "feed.put",             # node.py feeder, before each chunk put
     "feed.get",             # feed.py DataFeed, after each chunk pop
+    "data.serve",           # data/service.py worker, before each unit
     "rendezvous.register",  # rendezvous.py Client.register
     "rendezvous.query",     # rendezvous.py Client.await_reservations polls
     "checkpoint.save",      # utils/checkpoint.py save paths
