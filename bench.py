@@ -91,12 +91,18 @@ def _feeder_main(ring_name, mgr_addr, authkey_hex, total_records, image,
     telemetry.flush()
 
 
-def _fed_setup(batch, image, steps, columnar=True, tag=""):
+def _fed_setup(batch, image, steps, columnar=True, tag="", target=None,
+               extra=(), rec_bytes=None):
     """Pre-jax setup of the fed pipeline: IPC manager + shm ring + a real
     feeder process.  Must run before jax/the TPU tunnel initializes in
     this process: the feeder child is spawned with PYTHONPATH cleared so
     the axon site hook never runs in it, and the manager server is forked
-    before any accelerator state exists."""
+    before any accelerator state exists.
+
+    ``target`` swaps the feeder entry point (default ``_feeder_main``);
+    a custom target is called with ``(ring_name, mgr_addr, authkey_hex,
+    total_records, *extra)`` and ``rec_bytes`` sizes the ring for its
+    record width (stress_fed's pipeline A/B lanes use this)."""
     import multiprocessing as mp
     import secrets
 
@@ -114,7 +120,8 @@ def _fed_setup(batch, image, steps, columnar=True, tag=""):
     # with TFOS_FED_CHUNK (env TFOS_FED_RING_MB overrides).
     ring_mb = int(os.environ.get(
         "TFOS_FED_RING_MB",
-        str(max(64, 6 * FED_CHUNK * image * image * 3 // (1 << 20)))))
+        str(max(64, 6 * FED_CHUNK * (rec_bytes or image * image * 3)
+                // (1 << 20)))))
     ring = shmq.ShmQueue(ring_name, ring_mb << 20, create=True)
     mgr.set("shm_input", ring_name)
     total = (steps + 2) * batch  # +2 warmup batches
@@ -122,10 +129,15 @@ def _fed_setup(batch, image, steps, columnar=True, tag=""):
     saved = os.environ.get("PYTHONPATH")
     os.environ["PYTHONPATH"] = ""
     try:
+        if target is None:
+            args = (ring_name, list(mgr.address), authkey.hex(), total,
+                    image, None, columnar)
+        else:
+            args = (ring_name, list(mgr.address), authkey.hex(),
+                    total) + tuple(extra)
         proc = ctx.Process(
-            target=_feeder_main,
-            args=(ring_name, list(mgr.address), authkey.hex(), total, image,
-                  None, columnar),
+            target=target or _feeder_main,
+            args=args,
             daemon=True,
         )
         proc.start()
@@ -815,7 +827,8 @@ def main():
     for name, fn in (("tfrecord_read", _tfrecord_bench),
                      ("segmentation", _segmentation_bench),
                      ("batch_inference", _inference_bench),
-                     ("serve", _serve_bench)):
+                     ("serve", _serve_bench),
+                     ("data", _data_bench)):
         if os.environ.get(f"TFOS_BENCH_{name.upper()}", "1") != "0":
             try:
                 with telemetry.span(f"bench/{name}"):
@@ -1199,6 +1212,94 @@ def _serve_bench(dev, on_tpu):
                 compiles[sig] = compiles.get(sig, 0) + n
         if compiles:
             out["compiles"] = compiles
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _data_bench(dev, on_tpu):
+    """Input-pipeline lane (TFOS_BENCH_DATA=0 to skip): host-side rec/s
+    for the three feeding tiers over the same 784-float TFRecord shards —
+    (a) raw ``dfutil.iter_tfrecords_columnar``, (b) the composed data/
+    pipeline graph (interleave + map + batch + prefetch), (c) a mini
+    in-process data service serving one consumer over the manager wire
+    (queue transport, ledger-less).  Host-side only: never touches jax
+    or the device, so it is safe alongside a TPU claim (docs/data.md)."""
+    import secrets
+    import shutil
+    import tempfile
+    import threading
+
+    from tensorflowonspark_tpu import data, dfutil, recordio
+    from tensorflowonspark_tpu import manager as tfmanager
+    from tensorflowonspark_tpu.data import service as dsvc
+    from tensorflowonspark_tpu.feed import DataFeed
+
+    n = int(os.environ.get("TFOS_BENCH_DATA_RECORDS", "8192"))
+    width = 784
+    batch = 256
+    per = max(1, n // 4)
+    tmp = tempfile.mkdtemp(prefix="tfos_bench_data_")
+    try:
+        rng = np.random.default_rng(0)
+        for s in range(4):
+            base = rng.random((per, width), dtype=np.float32)
+            with recordio.TFRecordWriter(
+                    os.path.join(tmp, f"part-{s:05d}")) as w:
+                for i in range(per):
+                    w.write(recordio.encode_example(
+                        {"x": ("float", base[i].tolist()),
+                         "y": ("int64", [s * per + i])}))
+        total = 4 * per
+        out = {"records": total, "width": width, "batch": batch}
+
+        t0 = time.perf_counter()
+        seen = 0
+        for cols in dfutil.iter_tfrecords_columnar(tmp, batch):
+            seen += len(cols["y"])
+        out["raw_records_per_sec"] = round(seen / (time.perf_counter() - t0),
+                                           1)
+
+        pipe = (data.from_tfrecords(tmp, block_size=batch)
+                .interleave(cycle_length=2)
+                .map(lambda b: {"x": b["x"] * (1.0 / 255.0), "y": b["y"]})
+                .batch(batch)
+                .prefetch(4))
+        t0 = time.perf_counter()
+        seen = 0
+        for blk in pipe.blocks():
+            seen += len(blk["y"])
+        out["pipeline_records_per_sec"] = round(
+            seen / (time.perf_counter() - t0), 1)
+
+        # mini data service: one trainer stream over the manager queue,
+        # drained by an in-process DataFeed consumer thread
+        authkey = secrets.token_bytes(16)
+        mgr = tfmanager.start(authkey, ["input", "output", "error"])
+        meta = {"executor_id": 0, "host": "localhost", "job_name": "worker",
+                "addr": list(mgr.address), "authkey": authkey.hex()}
+        svc = dsvc.DataService(
+            pipe, cluster_info=[meta],
+            cluster_meta={"server_addr": ("127.0.0.1", 1)},
+            qname="input", num_workers=1, worker_index=0)
+        feed = DataFeed(mgr, train_mode=True,
+                        input_mapping={"x": "x", "y": "y"})
+        got = [0]
+
+        def drain():
+            while got[0] < total:
+                cols = feed.next_batch_columns(batch)
+                got[0] += len(cols.get("y", ()))
+
+        t0 = time.perf_counter()
+        consumer = threading.Thread(target=drain, daemon=True)
+        consumer.start()
+        svc.run()
+        consumer.join(timeout=120)
+        dt = time.perf_counter() - t0
+        mgr.set("state", "stopped")
+        out["service_records_per_sec"] = round(got[0] / dt, 1)
+        out["service_records"] = got[0]
         return out
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

@@ -11,10 +11,17 @@ Two modes, A/B-able in one run:
              next_batch_columns dense pull (round-4 fast path)
 
 Usage: python scripts/stress_fed.py [--batch 256] [--image 224]
-           [--steps 24] [--mode both|rows|columnar]
+           [--steps 24] [--mode both|rows|columnar|pipeline]
 Prints one JSON line per mode:
   {"mode", "records_per_sec", "batches", "batch", "image"}
-"""
+
+``--mode pipeline`` runs the composed-pipeline A/B on the 784-float
+workload (ISSUE 5 acceptance): a per-record fed feeder (row append +
+columnar encode, the node.train closure idiom) vs the data/ pipeline
+graph (vectorized map -> batch -> prefetch -> ColumnChunk) pushing the
+SAME ring drained by the SAME DataFeed consumer; prints
+``pipeline_vs_fed`` speedup (>= 1.0 means the composed pipeline
+matches/beats the fed path)."""
 
 import argparse
 import json
@@ -25,6 +32,123 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tensorflowonspark_tpu.utils import telemetry  # noqa: E402
+
+
+def _f784_feeder_main(ring_name, mgr_addr, authkey_hex, total, width):
+    """Fed-baseline feeder for the 784-float workload: the per-record
+    row-append loop + columnar chunk encoder, exactly the node.train
+    feeder idiom (node.py) — the cost model the composed pipeline has to
+    match or beat."""
+    import numpy as np
+
+    import bench
+    from tensorflowonspark_tpu import manager as tfmanager
+    from tensorflowonspark_tpu import node as tfnode
+    from tensorflowonspark_tpu.recordio import shm as shmq
+
+    if telemetry.enabled():
+        telemetry.configure(node_id=f"feeder-{os.getpid()}", role="feeder")
+    encode = tfnode._make_chunk_encoder()
+    mgr = tfmanager.connect(tuple(mgr_addr), bytes.fromhex(authkey_hex))
+    ring = shmq.ShmQueue(ring_name, create=False, producer=True)
+    rng = np.random.default_rng(0)
+    pool = 2 * bench.FED_CHUNK
+    vecs = [rng.random(width, dtype=np.float32) for _ in range(pool)]
+    sent = 0
+    chunk = []
+    with telemetry.span("feeder/push", records=total, columnar=True):
+        while sent < total:
+            chunk.append((vecs[sent % pool] * (1.0 / 255.0),
+                          sent % 1000))
+            sent += 1
+            if len(chunk) >= bench.FED_CHUNK:
+                ring.put(encode(chunk))
+                chunk = []
+        if chunk:
+            ring.put(encode(chunk))
+        ring.put(None)  # end-of-feed marker
+    ring.close()
+    mgr.set("feeder_done", 1)
+    telemetry.flush()
+
+
+def _pipeline_feeder_main(ring_name, mgr_addr, authkey_hex, total, width):
+    """Composed-pipeline feeder: the same 784-float workload through the
+    data/ graph — vectorized map, batch, prefetch — emitting ColumnChunk
+    blocks straight onto the ring (no per-record python)."""
+    import numpy as np
+
+    import bench
+    from tensorflowonspark_tpu import data
+    from tensorflowonspark_tpu import manager as tfmanager
+    from tensorflowonspark_tpu.recordio import shm as shmq
+
+    if telemetry.enabled():
+        telemetry.configure(node_id=f"feeder-{os.getpid()}", role="feeder")
+    mgr = tfmanager.connect(tuple(mgr_addr), bytes.fromhex(authkey_hex))
+    ring = shmq.ShmQueue(ring_name, create=False, producer=True)
+    rng = np.random.default_rng(0)
+    x = rng.random((total, width), dtype=np.float32)
+    y = (np.arange(total, dtype=np.int64) % 1000)
+    pipe = (data.from_arrays({"image": x, "label": y},
+                             block_size=bench.FED_CHUNK)
+            .map(lambda b: {"image": b["image"] * (1.0 / 255.0),
+                            "label": b["label"]})
+            .batch(bench.FED_CHUNK)
+            .prefetch(4))
+    with telemetry.span("feeder/push", records=total, pipeline=True):
+        for chunk in pipe.chunks():
+            ring.put(chunk)
+        ring.put(None)  # end-of-feed marker
+    ring.close()
+    mgr.set("feeder_done", 1)
+    telemetry.flush()
+
+
+def run_f784(mode, batch, width, steps):
+    """One 784-float lane: mode 'fed784' (row feeder) or 'pipeline784'
+    (composed graph), drained by the identical DataFeed consumer."""
+    import numpy as np
+
+    import bench
+    from tensorflowonspark_tpu.feed import DataFeed
+
+    target = (_pipeline_feeder_main if mode == "pipeline784"
+              else _f784_feeder_main)
+    fed = bench._fed_setup(batch, 0, steps, tag=f"-{mode}", target=target,
+                           extra=(width,), rec_bytes=width * 4)
+    if fed is None:
+        return {"mode": mode, "error": "shm unavailable"}
+    feed = DataFeed(fed["mgr"], train_mode=True,
+                    input_mapping={"image": "image", "label": "label"})
+    n_batches = 0
+    n_records = 0
+    t0 = None
+    dt = 0.0
+    try:
+        while not feed.should_stop():
+            cols = feed.next_batch_columns(batch)
+            vecs = cols["image"]
+            labels = np.asarray(cols["label"], np.int32)
+            n = len(labels)
+            if n == 0:
+                continue
+            assert vecs.shape[1] == width, vecs.shape
+            if t0 is None:  # skip the first batch (warmup)
+                t0 = time.perf_counter()
+            else:
+                n_batches += 1
+                n_records += n
+        dt = time.perf_counter() - t0 if t0 is not None else 0.0
+    finally:
+        fed["proc"].join(timeout=10)
+        if fed["proc"].is_alive():
+            fed["proc"].kill()
+        fed["mgr"].set("state", "stopped")
+        fed["ring"].close()
+    rps = n_records / dt if dt > 0 else 0.0
+    return {"mode": mode, "records_per_sec": round(rps, 1),
+            "batches": n_batches, "batch": batch, "width": width}
 
 
 def run_mode(mode, batch, image, steps):
@@ -83,13 +207,34 @@ def main():
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--image", type=int, default=224)
     ap.add_argument("--steps", type=int, default=24)
-    ap.add_argument("--mode", choices=("both", "rows", "columnar"),
+    ap.add_argument("--mode", choices=("both", "rows", "columnar",
+                                       "pipeline"),
                     default="both")
+    ap.add_argument("--width", type=int, default=784,
+                    help="record width for the --mode pipeline A/B lane")
     args = ap.parse_args()
     if os.environ.get(telemetry.DIR_ENV):
         # opt-in spans, same schema/dir layout as bench.py and the
         # cluster nodes (feed/wait comes from DataFeed when enabled)
         telemetry.configure(node_id="stress-fed", role="stress")
+    if args.mode == "pipeline":
+        results = []
+        for m in ("fed784", "pipeline784"):
+            with telemetry.span(f"stress_fed/{m}", batch=args.batch,
+                                width=args.width, steps=args.steps) as sp:
+                r = run_f784(m, args.batch, args.width, args.steps)
+                if "records_per_sec" in r:
+                    sp.add(records_per_sec=r["records_per_sec"])
+            print(json.dumps(r), flush=True)
+            results.append(r)
+        if all("records_per_sec" in r for r in results):
+            a, b = (results[0]["records_per_sec"],
+                    results[1]["records_per_sec"])
+            if a:
+                print(json.dumps({"pipeline_vs_fed": round(b / a, 2)}),
+                      flush=True)
+        telemetry.flush()
+        return
     modes = (["rows", "columnar"] if args.mode == "both" else [args.mode])
     results = []
     for m in modes:
