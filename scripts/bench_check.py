@@ -1,0 +1,219 @@
+#!/usr/bin/env python
+"""Perf-regression gate over the repo's ``BENCH_*.json`` lines.
+
+Diffs the newest usable bench line against the prior round, lane by
+lane (ResNet img/s, transformer tok/s, fed img/s, data rec/s, serve
+p99, ...), and exits non-zero when any lane regressed past the
+tolerance — the CI-shaped check the session scripts run after a bench
+step so a perf cliff is a red line in the log, not an archaeology
+project (PERF.md history stays the narrative; this is the gate).
+
+Bench files come in two shapes and both are handled:
+
+- bare bench lines (``BENCH_session_*.json``): the one-JSON-line
+  ``{"metric", "value", "unit", "extra": {lanes...}}`` record bench.py
+  prints;
+- driver wrappers (``BENCH_r0N.json``): ``{"n", "cmd", "rc", "tail",
+  "parsed"}`` where ``parsed`` (or the last JSON object line of
+  ``tail``) is the bench line.
+
+Fail-safe lines (``"value": null`` + ``extra.error`` — dead-tunnel
+rounds) carry no lane numbers and are skipped, so the gate compares
+the two most recent rounds that actually measured something.  Lanes
+disabled in one round (``TFOS_BENCH_*=0``) are simply absent and not
+compared — only lanes present on BOTH sides count.
+
+Exit codes: 0 OK / skip (nothing comparable), 1 regression,
+2 usage error.
+
+Usage::
+
+    python scripts/bench_check.py [--dir REPO] [--tolerance 0.10]
+    python scripts/bench_check.py --baseline OLD.json --latest NEW.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+TOL_ENV = "TFOS_BENCH_TOL"
+
+# (lane label, path into the bench line, higher_is_better).
+# ("value",) is the headline metric (ResNet train MFU).  NOTE: bench
+# lines before round 4 counted ResNet FLOPs as GMacs (exactly half the
+# 2-FLOPs/MAC convention) — mfu comparisons across that boundary are
+# apples-to-oranges; throughput lanes never changed convention.
+LANES = (
+    ("resnet.mfu", ("value",), True),
+    ("resnet.img_s", ("extra", "images_per_sec_per_chip"), True),
+    ("transformer.tok_s",
+     ("extra", "transformer", "tokens_per_sec_per_chip"), True),
+    ("fed.img_s", ("extra", "fed", "images_per_sec_per_chip"), True),
+    ("data.raw_rec_s", ("extra", "data", "raw_records_per_sec"), True),
+    ("data.pipeline_rec_s",
+     ("extra", "data", "pipeline_records_per_sec"), True),
+    ("data.service_rec_s",
+     ("extra", "data", "service_records_per_sec"), True),
+    ("tfrecord.columnar_rec_s",
+     ("extra", "tfrecord_read", "columnar_records_per_sec"), True),
+    ("serve.req_s", ("extra", "serve", "req_per_sec"), True),
+    ("serve.p99_ms", ("extra", "serve", "p99_ms"), False),
+)
+
+
+def _dig(obj, path):
+    for p in path:
+        if not isinstance(obj, dict) or p not in obj:
+            return None
+        obj = obj[p]
+    if isinstance(obj, bool) or not isinstance(obj, (int, float)):
+        return None
+    return float(obj)
+
+
+def extract_line(doc):
+    """The bench line dict from either file shape, or None."""
+    if not isinstance(doc, dict):
+        return None
+    if "metric" in doc:
+        return doc
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict) and "metric" in parsed:
+        return parsed
+    tail = doc.get("tail")
+    if isinstance(tail, str):
+        for line in reversed(tail.splitlines()):
+            line = line.strip()
+            if not (line.startswith("{") and line.endswith("}")):
+                continue
+            try:
+                cand = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(cand, dict) and "metric" in cand:
+                return cand
+    return None
+
+
+def lanes_of(line):
+    """{lane label: value} for every lane the line carries."""
+    out = {}
+    for label, path, _hib in LANES:
+        v = _dig(line, path)
+        if v is not None:
+            out[label] = v
+    return out
+
+
+def load_bench(path):
+    """(lanes dict, bench line) for one file; ({}, None) if unusable."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}, None
+    line = extract_line(doc)
+    if line is None:
+        return {}, None
+    return lanes_of(line), line
+
+
+def discover(bench_dir):
+    """Usable bench files, oldest -> newest.  Ordered by mtime with the
+    filename as tiebreak (checkout-restored files share one mtime;
+    BENCH_r01 < ... < BENCH_session_* sorts rounds correctly there)."""
+    paths = sorted(glob.glob(os.path.join(bench_dir, "BENCH_*.json")),
+                   key=lambda p: (os.path.getmtime(p), p))
+    out = []
+    for p in paths:
+        lanes, line = load_bench(p)
+        if lanes:
+            out.append((p, lanes))
+    return out
+
+
+def compare(old_lanes, new_lanes, tolerance):
+    """[(label, old, new, rel_change, regressed)] over shared lanes."""
+    rows = []
+    for label, _path, hib in LANES:
+        if label not in old_lanes or label not in new_lanes:
+            continue
+        old, new = old_lanes[label], new_lanes[label]
+        if old <= 0:
+            continue
+        rel = (new - old) / old
+        regressed = (rel < -tolerance) if hib else (rel > tolerance)
+        rows.append((label, old, new, rel, regressed))
+    return rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=None,
+                    help="directory holding BENCH_*.json (default: the "
+                         "repo root above this script)")
+    ap.add_argument("--tolerance", type=float,
+                    default=float(os.environ.get(TOL_ENV, "0.10")),
+                    help="allowed fractional regression per lane "
+                         f"(default 0.10; env {TOL_ENV})")
+    ap.add_argument("--baseline", default=None,
+                    help="explicit prior bench file (skips discovery)")
+    ap.add_argument("--latest", default=None,
+                    help="explicit newest bench file (skips discovery)")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress the per-lane table (verdict only)")
+    args = ap.parse_args(argv)
+
+    if bool(args.baseline) != bool(args.latest):
+        ap.error("--baseline and --latest must be given together")
+    if args.baseline:
+        old_path, new_path = args.baseline, args.latest
+        old_lanes, _ = load_bench(old_path)
+        new_lanes, _ = load_bench(new_path)
+        if not new_lanes or not old_lanes:
+            print("bench_check: ERROR unusable bench file "
+                  f"({old_path if not old_lanes else new_path})")
+            return 2
+    else:
+        bench_dir = args.dir or os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))
+        usable = discover(bench_dir)
+        if len(usable) < 2:
+            print(f"bench_check: SKIP ({len(usable)} usable BENCH line(s) "
+                  f"under {bench_dir}; need 2 to compare)")
+            return 0
+        (old_path, old_lanes), (new_path, new_lanes) = usable[-2], usable[-1]
+
+    rows = compare(old_lanes, new_lanes, args.tolerance)
+    if not rows:
+        print("bench_check: SKIP (no lane present in both "
+              f"{os.path.basename(old_path)} and "
+              f"{os.path.basename(new_path)})")
+        return 0
+    if not args.quiet:
+        for label, old, new, rel, regressed in rows:
+            flag = "REGRESSED" if regressed else "ok"
+            print(f"  {label:<24} {old:>12.2f} -> {new:>12.2f} "
+                  f"{rel:>+7.1%}  {flag}")
+    bad = [r for r in rows if r[4]]
+    names = (os.path.basename(new_path), os.path.basename(old_path))
+    if bad:
+        worst = max(bad, key=lambda r: abs(r[3]))
+        print(f"bench_check: REGRESSION {worst[0]} {worst[3]:+.1%} "
+              f"({worst[1]:.2f} -> {worst[2]:.2f}, tol "
+              f"{args.tolerance:.0%}) newest={names[0]} prior={names[1]} "
+              f"[{len(bad)}/{len(rows)} lanes regressed]")
+        return 1
+    worst = min(rows, key=lambda r: r[3] if r[4] is False else 0)
+    print(f"bench_check: OK newest={names[0]} prior={names[1]} "
+          f"lanes={len(rows)} worst={worst[0]} {worst[3]:+.1%} "
+          f"(tol {args.tolerance:.0%})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

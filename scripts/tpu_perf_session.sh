@@ -68,6 +68,9 @@ if [ "${TFOS_SESSION_SMOKE:-0}" = "1" ]; then
 else
   session_run 7200 python bench.py
 fi
+# perf-regression gate: newest BENCH line vs prior round (host-side,
+# no TPU claim; host_run never aborts the session on a red verdict)
+host_run 120 python scripts/bench_check.py
 
 echo "== done; promoted config: ==" | tee -a "$log"
 cat "${TFOS_BENCH_CONFIG:-bench_config.json}" 2>/dev/null | tee -a "$log" || \

@@ -99,6 +99,9 @@ else
     && mv BENCH_session_r5_final.json.tmp BENCH_session_r5_final.json \
     && cat BENCH_session_r5_final.json'
 fi
+# perf-regression gate: newest BENCH line vs prior round (host-side,
+# no TPU claim; host_run never aborts the session on a red verdict)
+host_run 120 python scripts/bench_check.py
 
 echo "== done; promoted config: ==" | tee -a "$log"
 cat "${TFOS_BENCH_CONFIG:-bench_config.json}" 2>/dev/null | tee -a "$log" || true

@@ -311,6 +311,9 @@ def main(argv=None):
                     help="Chrome trace path (default <run_dir>/trace.json)")
     ap.add_argument("--summary-out", default=None,
                     help="also write the text summary to this path")
+    ap.add_argument("--summary-json", default=None, metavar="OUT",
+                    help="write the summary stats (the same numbers as "
+                         "the text report) as JSON for CI / bench_check")
     args = ap.parse_args(argv)
 
     if not os.path.isdir(args.run_dir):
@@ -325,10 +328,14 @@ def main(argv=None):
     trace = to_chrome_trace(pairs)
     with open(out, "w", encoding="utf-8") as f:
         json.dump(trace, f)
-    text, _stats = summarize(pairs, skipped)
+    text, stats = summarize(pairs, skipped)
     if args.summary_out:
         with open(args.summary_out, "w", encoding="utf-8") as f:
             f.write(text)
+    if args.summary_json:
+        with open(args.summary_json, "w", encoding="utf-8") as f:
+            json.dump(stats, f, indent=1, sort_keys=True, default=str)
+            f.write("\n")
     sys.stdout.write(text)
     print(f"\nchrome trace: {out} ({len(trace['traceEvents'])} events) — "
           f"load at https://ui.perfetto.dev")

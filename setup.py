@@ -26,6 +26,8 @@ setup(
             "tfos-inference=tensorflowonspark_tpu.inference:main",
             # online serving (docs/serving.md) — no reference equivalent
             "tfos-serve=tensorflowonspark_tpu.serving.server:main",
+            # live cluster view (docs/observability.md)
+            "tfos-top=tensorflowonspark_tpu.obs.top:main",
         ],
     },
 )

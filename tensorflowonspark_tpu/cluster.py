@@ -361,6 +361,12 @@ class TFCluster:
                     sys.exit(1)
         finally:
             watchdog.cancel()
+            if self.obs is not None:
+                try:
+                    self.obs.stop()
+                except Exception:  # noqa: BLE001 - teardown
+                    pass
+                self.obs = None
             self.server.stop()
             telemetry.flush()
         logger.info("cluster shut down")
@@ -400,6 +406,7 @@ class TFCluster:
         return None
 
     _launcher = None
+    obs = None  # live ObsServer when TFOS_OBS_PORT is set (obs/http.py)
 
 
 def _quiesce_node(m):
@@ -637,4 +644,10 @@ def run(
         "cluster/start", time.perf_counter() - start_t0,
         cluster=f"{cluster_meta['id'] & 0xffffffff:x}",
         executors=num_executors, nodes=len(c.cluster_info))
+    # live observability endpoint (/metrics /healthz /statusz): only
+    # when TFOS_OBS_PORT is set; start_for_cluster returns None otherwise
+    # (no server, no threads — docs/observability.md)
+    from tensorflowonspark_tpu import obs as _obs
+
+    c.obs = _obs.start_for_cluster(c)
     return c

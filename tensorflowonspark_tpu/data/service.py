@@ -44,7 +44,8 @@ import os
 import time
 
 from tensorflowonspark_tpu import rendezvous
-from tensorflowonspark_tpu.utils import faults, telemetry
+from tensorflowonspark_tpu.obs import publish as obs_publish
+from tensorflowonspark_tpu.utils import faults, metrics_registry, telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -149,6 +150,7 @@ class DataService:
                 self.worker_index, st.rank, st.unit, skip)
             telemetry.event("data/serve_resume", trainer=st.rank,
                             unit=st.unit, skip_blocks=skip)
+            metrics_registry.inc("tfos_data_resumes_total")
         n_trainers = len(trainer_ranks(self.cluster_info))
         st.chunks = self.pipeline.shard(st.rank, n_trainers).chunks(
             skip_blocks=skip)
@@ -203,6 +205,8 @@ class DataService:
             st.done = True
             return
         st.pushed += len(chunk)
+        metrics_registry.inc("tfos_data_records_total", len(chunk),
+                             trainer=st.rank)
         st.unit_off += 1
         if st.unit_off >= self.unit_blocks:
             # exactly-once barrier: a unit enters the ledger only after
@@ -223,9 +227,22 @@ class DataService:
     def _record_done(self, st, client):
         try:
             client.partition_done(ledger_feed(self.qname, st.rank), st.unit)
+            metrics_registry.inc("tfos_data_units_total")
         except Exception as e:  # noqa: BLE001 - accounting only
             logger.warning("data worker: could not record unit %d for "
                            "trainer %d: %s", st.unit, st.rank, e)
+
+    def _publish_obs(self, assigned):
+        """Ship this worker's registry snapshot through the first live
+        trainer manager (any reachable manager KV works — the driver's
+        ObsServer sweeps every ``obs:*`` key it can see)."""
+        if not metrics_registry.enabled():
+            return
+        for st in assigned:
+            if st.mgr is not None:
+                if obs_publish.publish_once(
+                        st.mgr, f"data-{self.worker_index}", role="data"):
+                    return
 
     def run(self):
         """Serve all assigned trainers to completion; returns a summary
@@ -244,6 +261,7 @@ class DataService:
             logger.debug("data worker: rendezvous unavailable (%s)", e)
             client = _NullClient()
         t0 = time.perf_counter()
+        next_pub = 0.0
         try:
             for st in assigned:
                 self._open(st, client)
@@ -251,7 +269,13 @@ class DataService:
                 for st in assigned:
                     if not st.done:
                         self._advance(st, client)
+                if (metrics_registry.enabled()
+                        and time.monotonic() >= next_pub):
+                    next_pub = (time.monotonic()
+                                + metrics_registry.interval())
+                    self._publish_obs(assigned)
         finally:
+            self._publish_obs(assigned)
             for st in assigned:
                 if st.ring is not None:
                     try:

@@ -49,7 +49,7 @@ import multiprocessing as mp
 
 import cloudpickle
 
-from tensorflowonspark_tpu.utils import faults, telemetry
+from tensorflowonspark_tpu.utils import faults, metrics_registry, telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -367,6 +367,8 @@ class LocalEngine:
         )
         self._pump.start()
         atexit.register(self.stop)
+        metrics_registry.set_gauge("tfos_engine_executors",
+                                   self.num_executors)
         logger.info(
             "LocalEngine started %d executors under %s", self.num_executors, self._root
         )
@@ -420,6 +422,11 @@ class LocalEngine:
                 self._procs[index] = self._spawn_executor(index)
         telemetry.event("engine/executor_respawn", executor=index,
                         respawns=self._respawns)
+        metrics_registry.inc("tfos_engine_respawns_total")
+        if metrics_registry.enabled():
+            metrics_registry.set_gauge(
+                "tfos_engine_executors",
+                sum(1 for p in self._procs if p.is_alive()))
         logger.warning("respawned executor %d (%d/%d respawns used)",
                        index, self._respawns, self._respawn_budget)
         return True
@@ -496,9 +503,16 @@ class LocalEngine:
         with telemetry.span("engine/job", job=job_id, tasks=len(tasks),
                             spread=bool(spread or placement is not None),
                             retryable=bool(retryable)):
-            return self._run_job_inner(
-                tasks, collect, spread, placement, job_id, my_results,
-                retryable, max_retries)
+            try:
+                out = self._run_job_inner(
+                    tasks, collect, spread, placement, job_id, my_results,
+                    retryable, max_retries)
+            except BaseException:
+                metrics_registry.inc("tfos_engine_jobs_total",
+                                     status="error")
+                raise
+            metrics_registry.inc("tfos_engine_jobs_total", status="ok")
+            return out
 
     def _run_job_inner(self, tasks, collect, spread, placement, job_id,
                        my_results, retryable=False, max_retries=None):
@@ -562,6 +576,7 @@ class LocalEngine:
             """Count a failed attempt; queue a backoff re-dispatch or fail
             the job once the budget is spent (poison task)."""
             failures[tid].append(reason)
+            metrics_registry.inc("tfos_engine_tasks_total", status="error")
             running.pop(tid, None)
             if attempts[tid] >= max_retries:
                 if retryable:
@@ -574,6 +589,7 @@ class LocalEngine:
             retry_at[tid] = time.monotonic() + delay
             telemetry.event("engine/task_retry", job=job_id, task=tid,
                             attempt=attempts[tid], delay_ms=int(delay * 1000))
+            metrics_registry.inc("tfos_engine_task_retries_total")
             logger.warning(
                 "task %d of job %d failed (attempt %d of %d); retrying "
                 "in %.2fs", tid, job_id, attempts[tid], max_retries + 1, delay)
@@ -624,6 +640,8 @@ class LocalEngine:
                 if status == "error":
                     if max_retries == 0:
                         failures[tid].append(payload)
+                        metrics_registry.inc("tfos_engine_tasks_total",
+                                             status="error")
                         _fail_permanently(tid)
                     _schedule_retry(tid, payload)
                     continue
@@ -638,6 +656,7 @@ class LocalEngine:
                             f"be deserialized: {e!r}") from e
                 done[tid] = True
                 ndone += 1
+                metrics_registry.inc("tfos_engine_tasks_total", status="ok")
             return results
         finally:
             with self._job_lock:

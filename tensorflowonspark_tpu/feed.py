@@ -19,7 +19,7 @@ import threading
 import time
 
 from tensorflowonspark_tpu import marker
-from tensorflowonspark_tpu.utils import faults, telemetry
+from tensorflowonspark_tpu.utils import faults, metrics_registry, telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -158,7 +158,8 @@ class DataFeed:
         local check), 1s on the manager-queue compat path where every
         attempt is a proxy RPC — the stop flag only needs sub-second
         responsiveness, not a 10Hz round-trip load on the manager."""
-        timed = self.metrics is not None or telemetry.enabled()
+        timed = (self.metrics is not None or telemetry.enabled()
+                 or metrics_registry.enabled())
         t0 = time.perf_counter() if timed else None
         slice_ms = 100 if self._ring is not None else 1000
         while True:
@@ -179,16 +180,37 @@ class DataFeed:
             self._wait_acc += dt
             if self.metrics is not None:
                 self.metrics.infeed_wait(dt)
-            if telemetry.enabled():
-                attrs = {"eof": chunk is None}
+            # depth read once, shared by telemetry and the live plane
+            qbytes = qchunks = None
+            if telemetry.enabled() or metrics_registry.enabled():
                 try:
                     if self._ring is not None:
-                        attrs["queue_bytes"] = self._ring.qsize_bytes()
+                        qbytes = self._ring.qsize_bytes()
                     elif self._queue is not None:
-                        attrs["queue_chunks"] = self._queue.qsize()
+                        qchunks = self._queue.qsize()
                 except Exception:  # noqa: BLE001 - depth is best-effort
                     pass
+            if telemetry.enabled():
+                attrs = {"eof": chunk is None}
+                if qbytes is not None:
+                    attrs["queue_bytes"] = qbytes
+                elif qchunks is not None:
+                    attrs["queue_chunks"] = qchunks
                 telemetry.record_span("feed/wait", dt, **attrs)
+            if metrics_registry.enabled():
+                metrics_registry.inc("tfos_feed_wait_seconds_total", dt)
+                metrics_registry.inc("tfos_feed_chunks_total")
+                try:
+                    metrics_registry.inc("tfos_feed_records_total",
+                                         len(chunk))
+                except TypeError:  # None (eof) or a length-less marker
+                    pass
+                if qbytes is not None:
+                    metrics_registry.set_gauge("tfos_feed_ring_bytes",
+                                               qbytes)
+                elif qchunks is not None:
+                    metrics_registry.set_gauge("tfos_feed_queue_depth",
+                                               qchunks)
         return chunk
 
     def _consumer_span(self, t0, out):

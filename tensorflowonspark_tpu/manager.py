@@ -41,6 +41,10 @@ logger = logging.getLogger(__name__)
 
 HEARTBEAT_KEY = "heartbeat"
 
+# KV key prefix for live metrics snapshots (obs/publish.py writes,
+# obs/http.py polls); the suffix is the publishing process's node id.
+OBS_KEY = "obs:"
+
 
 def heartbeat_interval():
     return float(os.environ.get("TFOS_HEARTBEAT_SECS", "2"))
@@ -132,6 +136,19 @@ class TFManager(BaseManager):
         prefix = "telemetry_spool:"
         return sorted(v for k, v in self.kv().items()
                       if str(k).startswith(prefix))
+
+    # -- live metrics channel (utils/metrics_registry.py, obs/) --------
+    # Every instrumented process reachable through this executor's
+    # manager publishes its registry snapshot under an id-unique KV key
+    # (same no-read-modify-write discipline as the spool channel); the
+    # driver's ObsServer polls the set and merges them into /metrics.
+
+    def obs_publish(self, node_id, payload):
+        self.kv().update({OBS_KEY + str(node_id): payload})
+
+    def obs_snapshots(self):
+        return {str(k)[len(OBS_KEY):]: v for k, v in self.kv().items()
+                if str(k).startswith(OBS_KEY)}
 
 
 # Server-side singletons (one manager process per executor).  Queues are

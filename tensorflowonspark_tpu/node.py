@@ -516,6 +516,13 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
             # liveness beacon for the feeder: a trainer that stops beating
             # is DEAD, one that beats while busy is merely SLOW
             hb = tfmanager.start_heartbeat(mgr)
+            # live metrics plane: snapshot this process's registry into
+            # the manager KV every TFOS_OBS_INTERVAL (None when disabled)
+            from tensorflowonspark_tpu.obs import publish as obs_publish
+
+            obs_id = f"{context.job_name}-{context.task_index}"
+            pub = obs_publish.start_publisher(mgr, obs_id,
+                                              role=context.job_name)
             try:
                 with telemetry.span("node/main", job=context.job_name,
                                     task=context.task_index):
@@ -527,6 +534,12 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
                 context.sync_exit_barrier()
             finally:
                 hb.set()
+                if pub is not None:
+                    pub.set()
+                    # the thread's final publish races process exit; land
+                    # the tail counts synchronously
+                    obs_publish.publish_once(mgr, obs_id,
+                                             role=context.job_name)
                 telemetry.flush()
 
         def wrapper_fn_background(args, context):

@@ -1,0 +1,27 @@
+"""Live observability plane (no reference equivalent — the reference's
+observability is log lines only, reference ``TFCluster.py:343-344``,
+SURVEY.md §5).
+
+Three layers, all stdlib-only and env-gated on ``TFOS_OBS_PORT``:
+
+- ``utils/metrics_registry.py`` — in-process counters/gauges/histograms
+  bumped by the instrumented subsystems (engine, feed, train metrics,
+  data service, serving, checkpoint).
+- ``obs/publish.py`` — a per-node daemon thread snapshotting the
+  registry into the executor manager's KV (``obs:<node_id>`` keys).
+- ``obs/http.py`` — the driver-side HTTP server polling every node's
+  KV and exposing ``/metrics`` (Prometheus text), ``/healthz`` and
+  ``/statusz``; ``obs/top.py`` renders ``/statusz`` as a live table
+  (``tfos-top``).
+
+When ``TFOS_OBS_PORT`` is unset everything here is inert: no server,
+no threads, no registry, and every instrumentation call is a cached
+no-op (see docs/observability.md).
+"""
+
+from tensorflowonspark_tpu.utils.metrics_registry import (  # noqa: F401
+    PORT_ENV,
+    enabled,
+)
+from tensorflowonspark_tpu.obs.http import ObsServer, start_for_cluster  # noqa: F401
+from tensorflowonspark_tpu.obs.publish import start_publisher  # noqa: F401

@@ -1,0 +1,372 @@
+"""Driver-side observability endpoint: /metrics, /healthz, /statusz.
+
+The driver half of the live metrics plane (node half:
+``obs/publish.py``).  ``ObsServer`` polls every cluster node's manager
+KV for published registry snapshots (``manager.TFManager.obs_snapshots``)
+and the heartbeat key (``manager.heartbeat_age``), merges them with the
+driver's own registry, and serves:
+
+- ``/metrics``  Prometheus text exposition; every series carries a
+  ``node`` label (``driver`` for driver-process metrics).
+- ``/healthz``  JSON liveness: a node is dead only when its heartbeat
+  age exceeds ``manager.stale_after()``; 200 when every node is live,
+  503 otherwise (load-balancer semantics).
+- ``/statusz``  JSON cluster snapshot: epoch, restart budget/used,
+  feed-ledger progress, and a per-node summary (last-seen, step rate,
+  queue depth, stall %, SLO percentiles) — what ``tfos-top`` renders.
+
+Gated on ``TFOS_OBS_PORT`` (no server, no threads, no polling when
+unset); port 0 binds an ephemeral port, exposed as ``server.port``.
+Transport/auth note: binds loopback by default (``TFOS_OBS_HOST`` to
+widen); the endpoint is read-only.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket as _socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tensorflowonspark_tpu import manager as tfmanager
+from tensorflowonspark_tpu.utils import metrics_registry
+
+logger = logging.getLogger(__name__)
+
+HOST_ENV = "TFOS_OBS_HOST"
+
+
+def _metric_total(snap, name):
+    """Sum of a counter's series values, or None when absent."""
+    ent = (snap or {}).get(name)
+    if not ent:
+        return None
+    return sum(s.get("value", 0.0) for s in ent.get("series", ()))
+
+
+def _metric_gauge(snap, name):
+    """First series value of a gauge, or None when absent."""
+    ent = (snap or {}).get(name)
+    if not ent or not ent.get("series"):
+        return None
+    return ent["series"][0].get("value")
+
+
+def _metric_hist(snap, name):
+    """First histogram series dict, or None when absent."""
+    ent = (snap or {}).get(name)
+    if not ent or not ent.get("series"):
+        return None
+    s = ent["series"][0]
+    return s if "count" in s else None
+
+
+def _round(v, nd=3):
+    return None if v is None else round(float(v), nd)
+
+
+def node_summary(snap):
+    """The per-node key-metric extraction ``/statusz`` ships and
+    ``tfos-top`` renders; every field is None when the node hasn't
+    reported that subsystem."""
+    out = {}
+    out["steps"] = _metric_total(snap, "tfos_train_steps_total")
+    h = _metric_hist(snap, "tfos_train_step_ms")
+    if h:
+        out["step_ms_p50"] = _round(metrics_registry.quantile(h, 0.5))
+        out["step_ms_p99"] = _round(metrics_registry.quantile(h, 0.99))
+    out["items_per_sec"] = _round(
+        _metric_gauge(snap, "tfos_train_items_per_sec"))
+    out["mfu"] = _round(_metric_gauge(snap, "tfos_train_mfu"), 4)
+    out["stall_frac"] = _round(
+        _metric_gauge(snap, "tfos_train_infeed_stall_frac"), 4)
+    ring = _metric_gauge(snap, "tfos_feed_ring_bytes")
+    out["queue_depth"] = (
+        ring if ring is not None
+        else _metric_gauge(snap, "tfos_feed_queue_depth"))
+    out["records"] = (
+        _metric_total(snap, "tfos_feed_records_total")
+        or _metric_total(snap, "tfos_data_records_total"))
+    out["respawns"] = _metric_total(snap, "tfos_engine_respawns_total")
+    sh = _metric_hist(snap, "tfos_serve_request_ms")
+    if sh:
+        out["serve_p50_ms"] = _round(metrics_registry.quantile(sh, 0.5))
+        out["serve_p99_ms"] = _round(metrics_registry.quantile(sh, 0.99))
+        sq = _metric_gauge(snap, "tfos_serve_queue_depth")
+        if sq is not None:
+            out["queue_depth"] = sq
+    return {k: v for k, v in out.items() if v is not None}
+
+
+class ObsServer:
+    """See module docstring.  ``cluster`` is a ``TFCluster`` (may be
+    None for a driver-only / serving-only endpoint)."""
+
+    def __init__(self, cluster=None, port=None, host=None, interval=None):
+        import os
+
+        self.cluster = cluster
+        if port is None:
+            port = int(os.environ.get(metrics_registry.PORT_ENV, "0") or 0)
+        self._req_port = int(port)
+        self.host = host or os.environ.get(HOST_ENV) or "127.0.0.1"
+        self.interval = (metrics_registry.interval()
+                         if interval is None else float(interval))
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._nodes = {}   # node_id -> payload + poll bookkeeping
+        self._mgrs = {}    # (host, executor_id) -> manager proxy
+        self._httpd = None
+        self._threads = []
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self):
+        httpd = ThreadingHTTPServer((self.host, self._req_port), _Handler)
+        httpd.daemon_threads = True
+        httpd.obs = self
+        self._httpd = httpd
+        t = threading.Thread(target=httpd.serve_forever,
+                             name="tfos-obs-http", daemon=True)
+        t.start()
+        self._threads.append(t)
+        p = threading.Thread(target=self._poll_loop,
+                             name="tfos-obs-poll", daemon=True)
+        p.start()
+        self._threads.append(p)
+        logger.info("obs: serving /metrics /healthz /statusz on %s", self.url)
+        return self
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1] if self._httpd else None
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self):
+        self._stop.set()
+        if self._httpd is not None:
+            try:
+                self._httpd.shutdown()
+                self._httpd.server_close()
+            except Exception:  # noqa: BLE001 - teardown
+                pass
+        for t in self._threads:
+            t.join(timeout=5)
+        self._mgrs.clear()
+
+    # -- node polling --------------------------------------------------
+
+    def _manager_for(self, meta):
+        key = (meta["host"], meta["executor_id"])
+        mgr = self._mgrs.get(key)
+        if mgr is not None:
+            return mgr
+        addr = tuple(meta["addr"])
+        candidates = [addr]
+        if addr[0] not in ("127.0.0.1", "localhost"):
+            candidates.append(("127.0.0.1", addr[1]))
+        old = _socket.getdefaulttimeout()
+        _socket.setdefaulttimeout(5)
+        try:
+            for cand in candidates:
+                try:
+                    mgr = tfmanager.connect(
+                        cand, bytes.fromhex(meta["authkey"]))
+                    self._mgrs[key] = mgr
+                    return mgr
+                except Exception:  # noqa: BLE001 - try next candidate
+                    continue
+        finally:
+            _socket.setdefaulttimeout(old)
+        return None
+
+    def _poll_node(self, meta):
+        node_id = f"{meta['job_name']}-{meta['task_index']}"
+        mgr = self._manager_for(meta)
+        if mgr is None:
+            return
+        try:
+            payloads = mgr.obs_snapshots()
+            hb_age = tfmanager.heartbeat_age(mgr)
+        except Exception:  # noqa: BLE001 - reconnect next round
+            self._mgrs.pop((meta["host"], meta["executor_id"]), None)
+            return
+        now = time.time()
+        with self._lock:
+            # the cluster node itself (heartbeat owner) ...
+            ent = self._nodes.setdefault(node_id, {"node_id": node_id})
+            ent.update(role=meta["job_name"],
+                       executor_id=meta["executor_id"],
+                       host=meta["host"], heartbeat_age_s=hb_age,
+                       polled_ts=now)
+            # ... plus every publisher reachable through its manager
+            # (trainer, data workers, feeders) keyed by published id
+            for nid, payload in payloads.items():
+                if not isinstance(payload, dict):
+                    continue
+                e = self._nodes.setdefault(nid, {"node_id": nid})
+                e.update(role=payload.get("role", e.get("role")),
+                         last_seen=payload.get("ts"),
+                         metrics=payload.get("metrics"),
+                         polled_ts=now)
+                e.setdefault("executor_id", meta["executor_id"])
+                e.setdefault("host", meta["host"])
+                if nid == node_id:
+                    e["heartbeat_age_s"] = hb_age
+
+    def poll_once(self):
+        """One sweep over the cluster's nodes (the poll thread's body;
+        callable directly in tests)."""
+        cluster = self.cluster
+        if cluster is None:
+            return
+        for meta in list(getattr(cluster, "cluster_info", ()) or ()):
+            if self._stop.is_set():
+                return
+            self._poll_node(meta)
+
+    def _poll_loop(self):
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception as e:  # noqa: BLE001 - keep serving
+                logger.debug("obs poll error: %s", e)
+            self._stop.wait(self.interval)
+
+    # -- endpoint bodies -----------------------------------------------
+
+    def _node_entries(self):
+        with self._lock:
+            return {nid: dict(e) for nid, e in self._nodes.items()}
+
+    def render_metrics(self):
+        pairs = []
+        driver = metrics_registry.snapshot()
+        if driver:
+            pairs.append(({"node": "driver"}, driver))
+        for nid, ent in sorted(self._node_entries().items()):
+            if ent.get("metrics"):
+                pairs.append(({"node": nid}, ent["metrics"]))
+        return metrics_registry.render_text(pairs)
+
+    def render_healthz(self):
+        stale = tfmanager.stale_after()
+        now = time.time()
+        nodes = {}
+        healthy = True
+        for nid, ent in sorted(self._node_entries().items()):
+            hb = ent.get("heartbeat_age_s")
+            seen = ent.get("last_seen")
+            alive = hb is None or hb < stale
+            if not alive:
+                healthy = False
+            nodes[nid] = {
+                "alive": alive,
+                "heartbeat_age_s": _round(hb),
+                "publish_age_s": _round(now - seen) if seen else None,
+            }
+        return {"status": "ok" if healthy else "unhealthy",
+                "nodes": nodes}
+
+    def render_statusz(self):
+        cluster = self.cluster
+        now = time.time()
+        out = {"ts": now, "url": self.url}
+        if cluster is not None:
+            meta = getattr(cluster, "meta", {}) or {}
+            cid = meta.get("id")
+            out["cluster"] = {
+                "id": f"{cid & 0xffffffff:x}" if cid is not None else None,
+                "epoch": meta.get("epoch"),
+                "num_executors": meta.get("num_executors"),
+                "restarts": getattr(cluster, "restarts", None),
+                "restarts_used": getattr(cluster, "_restarts_used", None),
+            }
+            feeds = getattr(getattr(cluster, "server", None), "_feeds", None)
+            if feeds:
+                out["feeds"] = {f: len(parts)
+                                for f, parts in sorted(feeds.items())}
+        nodes = {}
+        for nid, ent in sorted(self._node_entries().items()):
+            seen = ent.get("last_seen")
+            hb = ent.get("heartbeat_age_s")
+            nodes[nid] = {
+                "role": ent.get("role"),
+                "executor_id": ent.get("executor_id"),
+                "host": ent.get("host"),
+                "alive": hb is None or hb < tfmanager.stale_after(),
+                "heartbeat_age_s": _round(hb),
+                "last_seen_age_s": _round(now - seen) if seen else None,
+                "summary": node_summary(ent.get("metrics")),
+            }
+        driver = metrics_registry.snapshot()
+        if driver:
+            nodes["driver"] = {
+                "role": "driver", "alive": True,
+                "summary": node_summary(driver),
+            }
+        out["nodes"] = nodes
+        return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "tfos-obs/1"
+
+    def log_message(self, fmt, *args):  # quiet: scrape traffic
+        logger.debug("obs http: " + fmt, *args)
+
+    def _reply(self, code, body, ctype):
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        obs = self.server.obs
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                self._reply(200, obs.render_metrics(),
+                            "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/healthz":
+                h = obs.render_healthz()
+                code = 200 if h["status"] == "ok" else 503
+                self._reply(code, json.dumps(h, indent=1),
+                            "application/json")
+            elif path == "/statusz":
+                self._reply(200, json.dumps(obs.render_statusz(), indent=1),
+                            "application/json")
+            else:
+                self._reply(404, "not found: try /metrics /healthz /statusz",
+                            "text/plain")
+        except Exception as e:  # noqa: BLE001 - never kill the server
+            self._reply(500, f"obs error: {e}", "text/plain")
+
+
+def start_for_cluster(cluster):
+    """Start the driver endpoint for a cluster when ``TFOS_OBS_PORT``
+    is set; returns the running ObsServer or None (disabled)."""
+    import os
+
+    raw = os.environ.get(metrics_registry.PORT_ENV)
+    if raw is None:
+        return None
+    try:
+        port = int(raw)
+    except ValueError:
+        logger.warning("obs: %s=%r is not a port; metrics plane disabled",
+                       metrics_registry.PORT_ENV, raw)
+        return None
+    try:
+        return ObsServer(cluster, port=port).start()
+    except OSError as e:
+        logger.warning("obs: could not bind %s:%s (%s); metrics plane off",
+                       os.environ.get(HOST_ENV, "127.0.0.1"), port, e)
+        return None

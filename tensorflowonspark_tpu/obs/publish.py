@@ -1,0 +1,68 @@
+"""Node-side metrics publisher: registry snapshot -> manager KV.
+
+The executor-side half of the live metrics plane (driver half:
+``obs/http.py``).  Each instrumented process that holds a manager
+connection — the trainer (``node.wrapper_fn``), a data worker
+(``data/service.py``) — runs ``start_publisher`` / calls
+``publish_once`` to ship its ``metrics_registry.snapshot()`` into the
+manager KV under ``obs:<node_id>`` (``manager.TFManager.obs_publish``),
+where the driver's poll thread collects it.  Same wire and same
+best-effort discipline as the telemetry spool registry
+(``telemetry.register_with``): publishing must never take a worker
+down, and when ``TFOS_OBS_PORT`` is unset nothing runs at all.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+from tensorflowonspark_tpu.utils import metrics_registry
+
+logger = logging.getLogger(__name__)
+
+
+def publish_once(mgr, node_id, role=None):
+    """Snapshot this process's registry into the manager KV; returns
+    True when a payload landed.  Best-effort: a dead manager (node
+    tearing down) is a debug line, never an error."""
+    snap = metrics_registry.snapshot()
+    if snap is None:
+        return False
+    payload = {
+        "ts": time.time(),
+        "node_id": str(node_id),
+        "role": str(role or "proc"),
+        "pid": os.getpid(),
+        "metrics": snap,
+    }
+    try:
+        mgr.obs_publish(str(node_id), payload)
+        return True
+    except Exception as e:  # noqa: BLE001 - publishing is best-effort
+        logger.debug("obs publish failed for %s: %s", node_id, e)
+        return False
+
+
+def start_publisher(mgr, node_id, role=None, interval=None):
+    """Daemon thread publishing every ``interval`` seconds
+    (``TFOS_OBS_INTERVAL``); returns a stop Event, or None when the
+    metrics plane is disabled.  Setting the event publishes one final
+    snapshot so short-lived processes still land their tail counts."""
+    if not metrics_registry.enabled():
+        return None
+    period = metrics_registry.interval() if interval is None else float(interval)
+    stop = threading.Event()
+
+    def _run():
+        while not stop.wait(period):
+            if not publish_once(mgr, node_id, role):
+                # manager gone: the node is exiting, stop quietly
+                return
+        publish_once(mgr, node_id, role)
+
+    t = threading.Thread(target=_run, name="tfos-obs-publish", daemon=True)
+    t.start()
+    return stop

@@ -1,0 +1,158 @@
+"""``tfos-top`` — live cluster view over the /statusz endpoint.
+
+A ``top(1)``-style terminal view of a running cluster (no reference
+equivalent; the reference's only runtime surface is driver log lines,
+reference ``TFCluster.py:343-344``).  Polls ``/statusz`` from the
+driver's ``ObsServer`` (``obs/http.py``) and renders one row per node:
+role, liveness, step rate, queue depth, stall %, respawns, serving SLO
+percentiles.  Plain ANSI redraw (clear + reprint) rather than curses —
+it works over ssh, inside ``watch``, and in CI logs; ``--once`` prints
+a single snapshot and exits (the form the fast-lane test drives).
+
+Usage::
+
+    tfos-top [--url http://127.0.0.1:9090] [--interval 2] [--once]
+
+``--url`` defaults to ``http://127.0.0.1:$TFOS_OBS_PORT``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+from tensorflowonspark_tpu.utils import metrics_registry
+
+CLEAR = "\x1b[H\x1b[2J"
+
+COLUMNS = (
+    # (header, width, extractor) over a /statusz node entry
+    ("NODE", 14, lambda nid, e: nid),
+    ("ROLE", 9, lambda nid, e: e.get("role") or "?"),
+    ("UP", 4, lambda nid, e: "yes" if e.get("alive") else "DOWN"),
+    ("SEEN", 6, lambda nid, e: _secs(e.get("last_seen_age_s"))),
+    ("STEPS", 7, lambda nid, e: _num(_s(e).get("steps"))),
+    ("STEP-MS", 8, lambda nid, e: _num(_s(e).get("step_ms_p50"))),
+    ("ITEMS/S", 8, lambda nid, e: _num(_s(e).get("items_per_sec"))),
+    ("MFU%", 6, lambda nid, e: _pct(_s(e).get("mfu"))),
+    ("STALL%", 7, lambda nid, e: _pct(_s(e).get("stall_frac"))),
+    ("QDEPTH", 7, lambda nid, e: _num(_s(e).get("queue_depth"))),
+    ("RSPWN", 6, lambda nid, e: _num(_s(e).get("respawns"))),
+    ("P50/P99", 12, lambda nid, e: _slo(_s(e))),
+)
+
+
+def _s(entry):
+    return entry.get("summary") or {}
+
+
+def _num(v):
+    if v is None:
+        return "-"
+    f = float(v)
+    if f >= 10000:
+        return f"{f / 1000.0:.1f}k"
+    return str(int(f)) if f == int(f) else f"{f:.1f}"
+
+
+def _pct(v):
+    return "-" if v is None else f"{100.0 * float(v):.1f}"
+
+
+def _secs(v):
+    return "-" if v is None else f"{float(v):.1f}s"
+
+
+def _slo(summary):
+    p50, p99 = summary.get("serve_p50_ms"), summary.get("serve_p99_ms")
+    if p50 is None and p99 is None:
+        return "-"
+    return f"{_num(p50)}/{_num(p99)}"
+
+
+def fetch_statusz(url, timeout=5):
+    """GET <url>/statusz and parse it; raises URLError/ValueError."""
+    with urllib.request.urlopen(url.rstrip("/") + "/statusz",
+                                timeout=timeout) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+def render(status):
+    """One snapshot -> the table text (no ANSI; the live loop adds the
+    clear sequence)."""
+    lines = []
+    cl = status.get("cluster") or {}
+    head = (f"tfos-top — cluster {cl.get('id', '?')} "
+            f"epoch={cl.get('epoch', '?')} "
+            f"restarts={cl.get('restarts_used', 0)}/{cl.get('restarts', 0)} "
+            f"nodes={len(status.get('nodes') or {})}")
+    lines.append(head)
+    feeds = status.get("feeds") or {}
+    if feeds:
+        prog = " ".join(f"{f}:{n}" for f, n in sorted(feeds.items()))
+        lines.append(f"feed ledger: {prog}")
+    lines.append("")
+    lines.append(" ".join(h.ljust(w) for h, w, _ in COLUMNS).rstrip())
+    for nid, ent in sorted((status.get("nodes") or {}).items()):
+        row = " ".join(
+            str(fn(nid, ent))[:w].ljust(w) for _, w, fn in COLUMNS)
+        lines.append(row.rstrip())
+    return "\n".join(lines) + "\n"
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="tfos-top",
+        description="live per-node view of a TFOS cluster's /statusz")
+    port = os.environ.get(metrics_registry.PORT_ENV)
+    p.add_argument("--url",
+                   default=f"http://127.0.0.1:{port}" if port else None,
+                   help="obs endpoint base URL "
+                        "(default: http://127.0.0.1:$TFOS_OBS_PORT)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh period, seconds (default 2)")
+    p.add_argument("--once", action="store_true",
+                   help="print one snapshot and exit")
+    return p
+
+
+def main(argv=None, out=None):
+    out = out or sys.stdout
+    args = build_parser().parse_args(argv)
+    if not args.url:
+        print("tfos-top: no --url and TFOS_OBS_PORT is unset",
+              file=sys.stderr)
+        return 2
+    while True:
+        try:
+            status = fetch_statusz(args.url)
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            if args.once:
+                print(f"tfos-top: {args.url} unreachable: {e}",
+                      file=sys.stderr)
+                return 2
+            out.write(f"{CLEAR}tfos-top: {args.url} unreachable "
+                      f"({e}); retrying...\n")
+            out.flush()
+            time.sleep(args.interval)
+            continue
+        text = render(status)
+        if args.once:
+            out.write(text)
+            out.flush()
+            return 0
+        out.write(CLEAR + text)
+        out.flush()
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,6 +38,8 @@ import time
 
 import numpy as np
 
+from tensorflowonspark_tpu.utils import metrics_registry
+
 logger = logging.getLogger(__name__)
 
 MAX_BATCH_ENV = "TFOS_SERVE_MAX_BATCH"
@@ -278,6 +280,7 @@ class MicroBatcher:
             raise TypeError(
                 "example must be a non-empty {tensor_name: array} dict")
         depth = self._q.qsize()
+        metrics_registry.set_gauge("tfos_serve_queue_depth", depth)
         if depth >= self.queue_max:
             # shed BEFORE enqueueing: bounded queue depth is the whole
             # point — admitting then failing would still grow memory

@@ -40,7 +40,7 @@ import cloudpickle
 import numpy as np
 
 from tensorflowonspark_tpu import manager as tfmanager
-from tensorflowonspark_tpu.utils import telemetry
+from tensorflowonspark_tpu.utils import metrics_registry, telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -621,6 +621,7 @@ class ReplicaPool:
             if step is None or step == last:
                 continue
             last = step
+            metrics_registry.inc("tfos_serve_reloads_total")
             telemetry.event(telemetry.SERVE_RELOAD, step=step)
             logger.info("hot-reload: broadcasting checkpoint step %d", step)
             with self._lock:

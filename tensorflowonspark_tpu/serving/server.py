@@ -34,7 +34,7 @@ import numpy as np
 from tensorflowonspark_tpu.serving import batcher as _batcher
 from tensorflowonspark_tpu.serving.batcher import MicroBatcher, Overloaded
 from tensorflowonspark_tpu.serving.replicas import ModelSpec, ReplicaPool
-from tensorflowonspark_tpu.utils import telemetry
+from tensorflowonspark_tpu.utils import metrics_registry, telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -144,13 +144,15 @@ class Server:
         self.batcher = MicroBatcher(
             self.pool.dispatch, max_batch=max_batch,
             max_delay_ms=max_delay_ms, queue_max=queue_max,
-            observer=self._on_request, batch_observer=self.stats.observe_batch,
+            observer=self._on_request, batch_observer=self._on_batch,
             on_shed=self._on_shed)
         self._stopped = False
 
-    # -- observers (batcher -> stats + telemetry) ---------------------------
+    # -- observers (batcher -> stats + telemetry + live metrics) ------------
     def _on_request(self, attrs):
         self.stats.observe_request(attrs)
+        metrics_registry.inc("tfos_serve_requests_total", status="ok")
+        metrics_registry.observe("tfos_serve_request_ms", attrs["total_ms"])
         telemetry.record_span(
             telemetry.SERVE_REQUEST, attrs["total_ms"] / 1e3,
             queue_ms=round(attrs["queue_ms"], 3),
@@ -158,8 +160,14 @@ class Server:
             device_ms=round(attrs["device_ms"], 3),
             batch=attrs["batch"], bucket=attrs["bucket"])
 
+    def _on_batch(self, batch, meta):
+        self.stats.observe_batch(batch, meta)
+        metrics_registry.inc("tfos_serve_batches_total")
+        metrics_registry.inc("tfos_serve_batch_rows_total", batch.n_valid)
+
     def _on_shed(self, depth, limit):
         self.stats.observe_shed()
+        metrics_registry.inc("tfos_serve_requests_total", status="shed")
         telemetry.event(telemetry.SERVE_SHED, depth=depth, limit=limit)
 
     # -- lifecycle ----------------------------------------------------------
@@ -195,6 +203,7 @@ class Server:
             raise
         except Exception:
             self.stats.observe_error()
+            metrics_registry.inc("tfos_serve_requests_total", status="error")
             raise
 
     def client(self):

@@ -18,11 +18,12 @@ import io
 import json
 import logging
 import os
+import time
 
 import numpy as np
 
 from tensorflowonspark_tpu.recordio import fs as _fs
-from tensorflowonspark_tpu.utils import faults, telemetry
+from tensorflowonspark_tpu.utils import faults, metrics_registry, telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -51,6 +52,7 @@ def _unflatten(flat):
 def save_checkpoint(ckpt_dir, params, step, keep=3):
     """Write step-stamped npz checkpoint to any filesystem (local,
     gs://, hdfs://, ... via fsspec); prune old ones."""
+    t0 = time.perf_counter()
     with telemetry.span("checkpoint/save", step=step):
         faults.check("checkpoint.save", step=step)
         _fs.makedirs(ckpt_dir)
@@ -75,6 +77,9 @@ def save_checkpoint(ckpt_dir, params, step, keep=3):
         )
         for old in ckpts[:-keep]:
             _fs.remove(_fs.join(ckpt_dir, old))
+        metrics_registry.inc("tfos_checkpoint_saves_total")
+        metrics_registry.observe("tfos_checkpoint_save_ms",
+                                 (time.perf_counter() - t0) * 1000.0)
         return path
 
 
@@ -89,9 +94,14 @@ def latest_checkpoint(ckpt_dir):
 
 
 def load_checkpoint(path):
+    t0 = time.perf_counter()
     with telemetry.span("checkpoint/restore", path=os.path.basename(path)):
         with _fs.open_file(path, "rb") as f, np.load(f) as z:
-            return _unflatten({k: z[k] for k in z.files})
+            out = _unflatten({k: z[k] for k in z.files})
+        metrics_registry.inc("tfos_checkpoint_restores_total")
+        metrics_registry.observe("tfos_checkpoint_restore_ms",
+                                 (time.perf_counter() - t0) * 1000.0)
+        return out
 
 
 def export_model(export_dir, params, ctx=None, metadata=None):

@@ -20,7 +20,7 @@ import logging
 import os
 import time
 
-from tensorflowonspark_tpu.utils import telemetry
+from tensorflowonspark_tpu.utils import metrics_registry, telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -148,6 +148,23 @@ class TrainMetrics:
                 if self._peak:
                     attrs["peak_flops"] = self._peak
                 telemetry.record_span("train/step", dur, **attrs)
+            if metrics_registry.enabled():
+                # live plane: the same windowed numbers report() derives,
+                # published mid-run by obs/publish.py
+                metrics_registry.inc("tfos_train_steps_total")
+                metrics_registry.observe("tfos_train_step_ms", dur * 1000.0)
+                if self.step_time:
+                    metrics_registry.set_gauge(
+                        "tfos_train_items_per_sec",
+                        self.items / self.step_time)
+                    metrics_registry.set_gauge(
+                        "tfos_train_infeed_stall_frac",
+                        min(self.infeed_time / self.step_time, 1.0))
+                    if self.flops_per_item and self._peak:
+                        metrics_registry.set_gauge(
+                            "tfos_train_mfu",
+                            self.items * self.flops_per_item
+                            / self.step_time / self._peak)
         self._last = now
         self.steps += 1
 

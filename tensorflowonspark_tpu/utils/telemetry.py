@@ -317,7 +317,14 @@ def register_with(mgr):
 
 def read_spool(spool_dir):
     """[(filename, jsonl_text), ...] for every record file in a spool —
-    the executor-side half of the drain (see node.drain_telemetry)."""
+    the executor-side half of the drain (see node.drain_telemetry).
+
+    Hardened against SIGKILLed writers: a process killed mid-``write``
+    leaves a truncated (or garbage) trailing line; such lines are
+    dropped and counted (one warning per file) instead of poisoning the
+    merged run directory — and this function never raises, because the
+    drain runs on live executors whose telemetry must not take them
+    down."""
     out = []
     try:
         names = sorted(os.listdir(spool_dir))
@@ -327,9 +334,28 @@ def read_spool(spool_dir):
         if not name.endswith(".jsonl"):
             continue
         try:
+            # errors="replace": a record cut inside a multi-byte UTF-8
+            # sequence must not abort the whole file
             with open(os.path.join(spool_dir, name),
-                      encoding="utf-8") as f:
-                out.append((name, f.read()))
+                      encoding="utf-8", errors="replace") as f:
+                raw = f.read()
         except OSError as e:
             logger.warning("telemetry drain: unreadable %s: %s", name, e)
+            continue
+        kept, skipped = [], 0
+        for line in raw.splitlines():
+            if not line.strip():
+                continue
+            try:
+                json.loads(line)
+            except ValueError:
+                skipped += 1
+                continue
+            kept.append(line)
+        if skipped:
+            logger.warning(
+                "telemetry drain: skipped %d truncated/corrupt line(s) "
+                "in %s", skipped, name)
+        if kept:
+            out.append((name, "\n".join(kept) + "\n"))
     return out

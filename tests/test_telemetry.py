@@ -322,6 +322,66 @@ def test_trace_merge_skips_malformed_lines(tmp_path):
     assert len(pairs) == 42 and skipped == 2
 
 
+def test_read_spool_skips_truncated_trailing_record(tmp_path):
+    """A writer SIGKILLed mid-write leaves a torn trailing line; the
+    drain must keep the valid prefix, drop the torn tail, and never
+    raise (it runs on live executors)."""
+    os.environ[telemetry.DIR_ENV] = str(tmp_path)
+    telemetry.configure(node_id="t-0", role="test")
+    telemetry.event("good", n=1)
+    telemetry.event("good", n=2)
+    telemetry.flush()
+    path = telemetry.sink_path()
+    with open(path, "r+", encoding="utf-8") as f:
+        whole = f.read()
+        head, last = whole.rstrip("\n").rsplit("\n", 1)
+        f.seek(0)
+        f.truncate()
+        # valid record, then a record cut mid-JSON with no newline
+        f.write(head + "\n" + last[: len(last) // 2])
+    # a sibling file that is ALL garbage is dropped entirely
+    (tmp_path / "junk-1.jsonl").write_text("\x00\x01 not json")
+
+    out = telemetry.read_spool(str(tmp_path))
+    by_name = dict(out)
+    assert os.path.basename(path) in by_name
+    assert "junk-1.jsonl" not in by_name
+    recs = [json.loads(ln) for ln in
+            by_name[os.path.basename(path)].splitlines()]
+    assert [r["attrs"]["n"] for r in recs if r["name"] == "good"] == [1]
+    # sanitized output ends with a newline (merge-safe concatenation)
+    assert by_name[os.path.basename(path)].endswith("\n")
+
+
+def test_read_spool_missing_dir_is_empty(tmp_path):
+    assert telemetry.read_spool(str(tmp_path / "nope")) == []
+
+
+def test_trace_merge_summary_json(tmp_path):
+    """--summary-json writes the machine-readable stats next to the
+    human summary, numbers identical to summarize()'s dict."""
+    _synthesize(tmp_path)
+    env = dict(os.environ, PYTHONPATH="")
+    out_json = tmp_path / "stats.json"
+    proc = subprocess.run(
+        [sys.executable, TRACE_MERGE, str(tmp_path),
+         "--summary-json", str(out_json)],
+        capture_output=True, text=True, env=env, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    stats = json.loads(out_json.read_text())
+    assert stats["records"] == 42 and stats["skipped"] == 0
+    tm = _load_trace_merge()
+    pairs, skipped = tm.load_records(str(tmp_path))
+    _text, want = tm.summarize(pairs, skipped)
+    for node in ("worker-0", "worker-1"):
+        assert stats["nodes"][node]["steps"] == 10
+        assert stats["nodes"][node]["p50_ms"] == \
+            pytest.approx(want["nodes"][node]["p50_ms"])
+        assert stats["nodes"][node]["mfu"] == \
+            pytest.approx(want["nodes"][node]["mfu"])
+    assert stats["phases"]["train/step"]["count"] == 20
+
+
 def test_trace_merge_cli(tmp_path):
     _synthesize(tmp_path)
     env = dict(os.environ, PYTHONPATH="")
