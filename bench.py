@@ -828,7 +828,8 @@ def main():
                      ("segmentation", _segmentation_bench),
                      ("batch_inference", _inference_bench),
                      ("serve", _serve_bench),
-                     ("data", _data_bench)):
+                     ("data", _data_bench),
+                     ("elastic", _elastic_bench)):
         if os.environ.get(f"TFOS_BENCH_{name.upper()}", "1") != "0":
             try:
                 with telemetry.span(f"bench/{name}"):
@@ -1303,6 +1304,33 @@ def _data_bench(dev, on_tpu):
         return out
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _elastic_bench(dev, on_tpu):
+    """Elastic-runtime lane (TFOS_BENCH_ELASTIC=0 to skip): mesh build /
+    resize / reshard / cross-mesh restore latencies on 8 fake CPU
+    devices (docs/elastic.md).  Runs scripts/bench_elastic.py in a
+    SUBPROCESS with a scrubbed CPU env so it never contends for the TPU
+    claim the main bench process may hold."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": "",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    })
+    root = os.path.dirname(os.path.abspath(__file__))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "scripts", "bench_elastic.py")],
+        capture_output=True, text=True, timeout=600, cwd=root, env=env)
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    if proc.returncode or not lines:
+        raise RuntimeError(
+            f"bench_elastic rc={proc.returncode}: "
+            f"{(proc.stderr or proc.stdout)[-300:]}")
+    return json.loads(lines[-1])
 
 
 if __name__ == "__main__":

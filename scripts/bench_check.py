@@ -62,6 +62,8 @@ LANES = (
      ("extra", "tfrecord_read", "columnar_records_per_sec"), True),
     ("serve.req_s", ("extra", "serve", "req_per_sec"), True),
     ("serve.p99_ms", ("extra", "serve", "p99_ms"), False),
+    ("elastic.resize_ms", ("extra", "elastic", "resize_ms"), False),
+    ("elastic.reshard_ms", ("extra", "elastic", "reshard_ms"), False),
 )
 
 

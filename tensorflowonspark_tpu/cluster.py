@@ -28,7 +28,7 @@ import time
 from tensorflowonspark_tpu import engine as engine_mod
 from tensorflowonspark_tpu import manager as tfmanager
 from tensorflowonspark_tpu import node, rendezvous
-from tensorflowonspark_tpu.utils import telemetry
+from tensorflowonspark_tpu.utils import metrics_registry, telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -55,10 +55,13 @@ class TFCluster:
     queues = None
     server = None
     restarts = 0
+    min_executors = 0
     _restarts_used = 0
     _node_fn = None
     _nodes_ds = None
     _node_ids = None
+    _all_node_ids = None
+    _template0 = None
 
     def train(self, dataset, num_epochs=1, feed_timeout=600, qname="input"):
         """Feed a dataset into the cluster (parity: TFCluster.train :63-94).
@@ -186,11 +189,13 @@ class TFCluster:
         terminating, poison its error queue so orphan feeders still
         blocked in await-consumption fail out and release their executor
         slots, kill the background trainer; (2) respawn dead executors so
-        the relaunch sees a full pool; (3) bump the epoch on the
-        rendezvous server BEFORE joining the old launcher, so any stale
-        in-flight re-registration REJECTS instead of contaminating the
-        new reservation table; (4) relaunch and await the new
-        incarnation."""
+        the relaunch sees a full pool — or, with ``min_executors=k``
+        elastic supervision, re-form the cluster over the surviving pool
+        when the heal falls short (the resize half of docs/elastic.md);
+        (3) bump the epoch on the rendezvous server BEFORE joining the
+        old launcher, so any stale in-flight re-registration REJECTS
+        instead of contaminating the new reservation table; (4) relaunch
+        and await the new incarnation."""
         self._restarts_used += 1
         epoch = int(self.meta.get("epoch", 0)) + 1
         telemetry.event("cluster/recover_begin", epoch=epoch,
@@ -203,8 +208,26 @@ class TFCluster:
                             restart=self._restarts_used):
             for m in self.cluster_info:
                 _quiesce_node(m)
+            heal_err = None
             if hasattr(self.engine, "ensure_executors"):
-                self.engine.ensure_executors()
+                try:
+                    self.engine.ensure_executors()
+                except Exception as e:  # noqa: BLE001 - budget exhausted
+                    if not self.min_executors:
+                        raise
+                    heal_err = e
+                    logger.warning(
+                        "pool heal failed (%s); proceeding elastically "
+                        "over the surviving executors", str(e)[:200])
+            if self.min_executors:
+                alive = self._alive_node_ids()
+                if len(alive) < self.min_executors:
+                    raise RuntimeError(
+                        f"elastic recovery impossible: {len(alive)} "
+                        f"executor(s) survive, min_executors="
+                        f"{self.min_executors}") from (heal_err or err)
+                if set(alive) != set(self._node_ids):
+                    self._resize_cluster(alive)
             self.meta["epoch"] = epoch  # node closures read this dict
             self.server.reset(epoch)
             if self._launcher is not None:
@@ -222,6 +245,46 @@ class TFCluster:
                         nodes=len(self.cluster_info))
         logger.info("recovery complete: epoch %d with %d nodes",
                     epoch, len(self.cluster_info))
+
+    def _alive_node_ids(self):
+        """Engine-hosted node ids still backed by a live executor,
+        computed against the ORIGINAL id set so a healed pool re-grows
+        the cluster instead of staying shrunk.  Engines that cannot
+        report liveness (sparkstub, pyspark) fall back to the current
+        rigid id list — elastic resize then never triggers."""
+        alive_fn = getattr(self.engine, "alive_executors", None)
+        if alive_fn is None:
+            return list(self._node_ids)
+        alive = set(alive_fn())
+        return sorted(i for i in self._all_node_ids if i in alive)
+
+    def _resize_cluster(self, alive_ids):
+        """Re-form the cluster template over ``alive_ids`` (shrink after
+        an unhealable loss, or re-grow after the pool came back).  The
+        node closures observe the change through ``cluster_meta`` — the
+        same mutated dict they captured at launch — and the rendezvous
+        server's reservation count moves BEFORE the epoch reset so the
+        next incarnation awaits exactly the surviving nodes."""
+        template = _elastic_template(self._template0, alive_ids)
+        old_n = len(self._node_ids)
+        self.meta["cluster_template"] = template
+        self.meta["num_executors"] = len(alive_ids)
+        self._node_ids = sorted(alive_ids)
+        retire = getattr(self.engine, "retire_executors", None)
+        if retire is not None:
+            # dead slots leave the engine's dispatch pool too, so re-fed
+            # spread jobs land only on the surviving executors (and a
+            # re-grow to the full pool un-retires everything)
+            retire(sorted(set(self._all_node_ids) - set(alive_ids)))
+        self._nodes_ds = self.engine.parallelize(
+            self._node_ids, len(self._node_ids))
+        self.server.resize(len(self._node_ids))
+        telemetry.event("cluster/resize", from_nodes=old_n,
+                        to_nodes=len(self._node_ids),
+                        template={k: list(v) for k, v in template.items()})
+        metrics_registry.inc("tfos_elastic_resizes_total", scope="cluster")
+        logger.warning("elastic resize: %d -> %d node(s), template %s",
+                       old_n, len(self._node_ids), template)
 
     def train_stream(self, stream, feed_timeout=600, qname="input"):
         """Feed a streaming source: an iterable of datasets (micro-batches).
@@ -452,6 +515,25 @@ def _quiesce_node(m):
         _socket.setdefaulttimeout(old)
 
 
+def _elastic_template(template, alive_ids):
+    """Shrink (or re-grow) a cluster template to the executors in
+    ``alive_ids``: every job keeps its surviving ids; a lost chief /
+    master seat is re-assigned the lowest surviving worker id (some node
+    must run task 0 of the coordinator job or rendezvous never
+    completes); dead ps / evaluator seats are dropped — their state
+    lives in checkpoints, not processes; jobs left empty disappear."""
+    alive = set(alive_ids)
+    out = {}
+    for job, ids in template.items():
+        out[job] = [i for i in ids if i in alive]
+    for coord in ("chief", "master"):
+        if coord in template and not out.get(coord):
+            workers = out.get("worker") or []
+            if workers:
+                out[coord] = [workers.pop(0)]
+    return {job: ids for job, ids in out.items() if ids}
+
+
 def _await_cluster(server, status, timeout):
     """Wait for every node of the (re)launched incarnation to register,
     then run the duplicate-registration sanity check
@@ -517,6 +599,7 @@ def run(
     background=None,
     restarts=0,
     data_workers=0,
+    min_executors=0,
 ):
     """Starts the distributed cluster (parity: TFCluster.run :215-383).
 
@@ -535,6 +618,15 @@ def run(
     ``train()`` is given a ``data.Pipeline`` instead of a dataset
     (docs/data.md); 0 defers to ``TFOS_DATA_WORKERS`` (default 1) at
     ``train()`` time.
+
+    ``min_executors``: elastic recovery floor (docs/elastic.md).  0
+    (default) keeps today's rigid semantics: recovery must heal the
+    pool back to full strength or the error propagates.  ``k > 0``
+    lets ``_recover`` re-form the cluster over however many executors
+    survive (>= k) when the respawn budget is exhausted — and re-grow
+    it on a later recovery if the pool comes back.  Nodes pick the new
+    shape up from ``ctx`` and re-place their train state through
+    ``elastic.ElasticRuntime.resize``/``restore``.
     """
     logger.info("Reserving TFSparkNodes-TPU")
     start_t0 = time.perf_counter()
@@ -635,6 +727,9 @@ def run(
     c._node_fn = node_fn
     c._nodes_ds = nodes_ds
     c._node_ids = node_ids
+    c.min_executors = int(min_executors)
+    c._all_node_ids = list(node_ids)
+    c._template0 = {k: list(v) for k, v in template.items()}
     c._launcher = c._spawn_launcher()
 
     # wait for all nodes to register (TFCluster.py:338), then the

@@ -1,0 +1,25 @@
+"""Elastic SPMD runtime: virtual-device meshes with resize-and-reshard.
+
+No reference equivalent (SURVEY.md §2.3/§2.4: the reference delegated
+collectives to TF and could only restart a fixed-size cluster).  This
+package decouples the logical mesh a model is configured for from the
+physical devices an incarnation happens to have (``virtual.py``),
+resolves rendezvous cluster specs into live meshes (``runtime.py``),
+and re-places train state when the topology changes under supervision
+(``reshard.py``).  Walkthrough: docs/elastic.md.
+"""
+
+from tensorflowonspark_tpu.elastic.reshard import (  # noqa: F401
+    host_fetch,
+    reshard,
+    reshard_train_state,
+)
+from tensorflowonspark_tpu.elastic.runtime import (  # noqa: F401
+    ElasticRuntime,
+    TrainSpec,
+    from_context,
+)
+from tensorflowonspark_tpu.elastic.virtual import (  # noqa: F401
+    VirtualLayout,
+    virtualize,
+)

@@ -1,0 +1,78 @@
+"""Resize-time resharding: re-place train state under a new mesh.
+
+The recovery half of the elastic runtime (SURVEY.md §5 grows beyond
+"restart job from checkpoint"): a checkpoint written under one mesh
+shape restores under another.  The contract is deliberately a **host
+round-trip** — every leaf is fetched to host memory first, then
+``jax.device_put`` lays it out under the target sharding — because at
+resize time the source placement is unusable by construction: the old
+mesh may reference devices that no longer exist (a lost executor's
+chips), and checkpoint restores arrive as host numpy anyway.  Values
+never change; only placement does.  Optimizer moments travel with their
+parameter's layout (``parallel/sharding.shard_train_state``), which is
+the whole resize story for mean-reduced losses: the global batch and
+the mean gradient are topology-invariant under the virtual layer, so
+moments need re-placement, not re-scaling.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+import numpy as np
+
+from tensorflowonspark_tpu.utils import metrics_registry, telemetry
+
+logger = logging.getLogger(__name__)
+
+
+def host_fetch(tree):
+    """Every leaf as host numpy (works for leaves placed under a dead or
+    foreign mesh: fetching is per-shard reads, not a collective)."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+
+
+def reshard(tree, target_shardings):
+    """Re-place ``tree`` under ``target_shardings`` via the host.
+
+    ``target_shardings`` is either a pytree of ``Sharding`` matching
+    ``tree`` (a prefix tree works, as with ``jax.device_put``) or a
+    callable ``tree -> shardings`` — the callable form lets callers
+    derive shardings from the restored structure itself (e.g.
+    ``lambda t: fsdp_sharding(new_mesh, t)``), which is what
+    ``utils/checkpoint.restore_any(target_shardings=...)`` passes
+    through.
+    """
+    import jax
+
+    if callable(target_shardings):
+        target_shardings = target_shardings(tree)
+    t0 = time.perf_counter()
+    with telemetry.span("elastic/reshard"):
+        placed = jax.device_put(host_fetch(tree), target_shardings)
+    metrics_registry.observe("tfos_elastic_reshard_ms",
+                             (time.perf_counter() - t0) * 1000.0)
+    return placed
+
+
+def reshard_train_state(layout, params, state, opt_state, fsdp_axis="fsdp"):
+    """Re-place a full train state under ``layout``'s mesh: fsdp for
+    params and optimizer moments, replicated model state — the same
+    rules as first placement (``shard_train_state``), applied through
+    the host round-trip so it works across a resize.
+
+    Returns ``((params, state, opt_state), (p_sh, s_sh, o_sh))`` like
+    ``shard_train_state``; the shardings feed the re-jit of the train
+    step under the new mesh.
+    """
+    t0 = time.perf_counter()
+    with telemetry.span("elastic/reshard_train_state"):
+        out = layout.shard_train_state(
+            host_fetch(params), host_fetch(state), host_fetch(opt_state),
+            fsdp_axis=fsdp_axis)
+    metrics_registry.observe("tfos_elastic_reshard_ms",
+                             (time.perf_counter() - t0) * 1000.0)
+    return out

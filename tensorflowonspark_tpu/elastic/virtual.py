@@ -1,0 +1,164 @@
+"""Virtual-device layer: a logical SPMD mesh decoupled from hardware.
+
+New-build capability beyond reference parity (SURVEY.md §2.3/§2.4: the
+reference could only ever run the cluster shape it was launched with —
+strategy choice and TF_CONFIG froze the topology at startup).  Here one
+``TrainSpec``'s *logical* mesh — e.g. ``data=8, fsdp=4`` = 32 virtual
+devices — runs unchanged on any physical device count that divides it
+(VirtualFlow, arXiv 2009.09523): the surplus factor folds out of the
+accumulation axis and is made up with per-virtual-node gradient
+accumulation (``utils/train.accumulated_value_and_grad``), so the
+optimizer sees the same global batch and the same mean gradient on
+1 chip or an N-chip slice.
+
+The algebra, for ``n_virtual = prod(logical)`` and ``n_physical``
+devices:
+
+- ``n_virtual % n_physical == 0`` (divisor topologies only — anything
+  else would change the per-virtual-node batch);
+- ``factor = n_virtual // n_physical`` divides the accumulation axis
+  (default ``data``), giving ``physical[accum] = logical[accum]/factor``
+  and ``accum_steps = factor``;
+- all other axes (``fsdp``/``model``/``seq``/``pp``/``ep``) keep their
+  logical size: collective-bearing axes never silently shrink, so a
+  layout that fits in HBM on the logical shape still fits after a
+  resize (the per-step microbatch shrinks instead).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from dataclasses import dataclass, field
+
+from tensorflowonspark_tpu.parallel.mesh import canonical_axes, make_mesh
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_ACCUM_AXIS = "data"
+
+
+@dataclass(frozen=True)
+class VirtualLayout:
+    """One resolved placement of a logical mesh onto physical devices.
+
+    ``logical`` is the stable shape a ``TrainSpec`` names; ``physical``
+    is what this incarnation's devices support; ``accum_steps`` bridges
+    the two (``prod(logical) == prod(physical) * accum_steps``).
+    ``mesh`` is the live ``jax.sharding.Mesh`` over ``physical``.
+    """
+
+    logical: dict = field(default_factory=dict)
+    physical: dict = field(default_factory=dict)
+    accum_axis: str = DEFAULT_ACCUM_AXIS
+    accum_steps: int = 1
+    mesh: object = None
+
+    @property
+    def n_virtual(self):
+        return math.prod(self.logical.values()) if self.logical else 1
+
+    @property
+    def n_physical(self):
+        return math.prod(self.physical.values()) if self.physical else 1
+
+    # -- sharding helpers (thin delegates so callers never need to know
+    # whether they are on the logical or a folded physical shape) -------
+
+    def batch_sharding(self, axes=("data", "fsdp")):
+        from tensorflowonspark_tpu.parallel import batch_sharding
+
+        return batch_sharding(self.mesh, axes=axes)
+
+    def fsdp_sharding(self, tree, axis="fsdp"):
+        from tensorflowonspark_tpu.parallel import fsdp_sharding
+
+        return fsdp_sharding(self.mesh, tree, axis)
+
+    def replicated(self):
+        from tensorflowonspark_tpu.parallel import replicated
+
+        return replicated(self.mesh)
+
+    def shard_train_state(self, params, state, opt_state, fsdp_axis="fsdp"):
+        from tensorflowonspark_tpu.parallel import shard_train_state
+
+        return shard_train_state(self.mesh, params, state, opt_state,
+                                 fsdp_axis=fsdp_axis)
+
+    def value_and_grad(self, loss_fn, has_aux=False, carry_aux=False):
+        """``jax.value_and_grad`` at this layout's accumulation depth:
+        the returned function consumes the full logical-mesh global
+        batch and replays it in ``accum_steps`` microbatches, so loss
+        and mean gradient match the logical shape exactly
+        (``utils/train.accumulated_value_and_grad``)."""
+        from tensorflowonspark_tpu.utils.train import (
+            accumulated_value_and_grad,
+        )
+
+        return accumulated_value_and_grad(
+            loss_fn, self.accum_steps, has_aux=has_aux, carry_aux=carry_aux)
+
+    def microbatch(self, global_batch):
+        """Per-dispatch batch after accumulation folding: the physical
+        step consumes this many rows ``accum_steps`` times per optimizer
+        update."""
+        if global_batch % self.accum_steps:
+            raise ValueError(
+                f"global batch {global_batch} not divisible by "
+                f"accum_steps={self.accum_steps}")
+        return global_batch // self.accum_steps
+
+    def describe(self):
+        return (f"logical={self.logical} physical={self.physical} "
+                f"accum={self.accum_steps}x{self.accum_axis} "
+                f"devices={self.n_physical}")
+
+
+def virtualize(logical_axes, devices, accum_axis=DEFAULT_ACCUM_AXIS):
+    """Place ``logical_axes`` (fully-specified virtual mesh shape) onto
+    ``devices``, folding any surplus through gradient accumulation.
+
+    Raises ``ValueError`` when the device count is not a divisor of the
+    virtual device count, when the surplus does not divide the
+    accumulation axis, or when the logical shape contains ``-1`` (a
+    virtual shape is the stable contract — it cannot absorb a device
+    count that changes under it).
+    """
+    logical = canonical_axes(dict(logical_axes))
+    if any(v == -1 for v in logical.values()):
+        raise ValueError(
+            f"virtual mesh shape must be fully specified, got {logical} "
+            "(-1 absorption is only meaningful against a fixed device "
+            "count; see parallel.mesh.MeshSpec)")
+    if any(v < 1 for v in logical.values()):
+        raise ValueError(f"virtual mesh axis sizes must be >= 1: {logical}")
+    accum_axis = canonical_axes({accum_axis: 1}).popitem()[0]
+    devices = list(devices)
+    n_virtual = math.prod(logical.values()) if logical else 1
+    n_physical = len(devices)
+    if n_physical < 1:
+        raise ValueError("virtualize: empty device list")
+    if n_virtual % n_physical:
+        raise ValueError(
+            f"{n_physical} devices is not a divisor topology of the "
+            f"virtual mesh {logical} ({n_virtual} virtual devices)")
+    factor = n_virtual // n_physical
+    physical = dict(logical)
+    if factor > 1:
+        if accum_axis not in logical:
+            raise ValueError(
+                f"virtual mesh {logical} has no '{accum_axis}' axis to "
+                f"fold the {factor}x device deficit into")
+        if logical[accum_axis] % factor:
+            raise ValueError(
+                f"cannot fold {factor}x into axis '{accum_axis}' of size "
+                f"{logical[accum_axis]} (virtual {logical} on "
+                f"{n_physical} devices)")
+        physical[accum_axis] = logical[accum_axis] // factor
+    mesh = make_mesh(physical, devices=devices)
+    layout = VirtualLayout(logical=logical, physical=physical,
+                           accum_axis=accum_axis, accum_steps=factor,
+                           mesh=mesh)
+    logger.info("virtualize: %s", layout.describe())
+    return layout

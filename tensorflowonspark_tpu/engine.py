@@ -351,6 +351,7 @@ class LocalEngine:
         self._retry_backoff = float(os.environ.get("TFOS_RETRY_BACKOFF", "0.25"))
         self._respawn_budget = int(os.environ.get("TFOS_EXECUTOR_RESPAWNS", "8"))
         self._respawns = 0
+        self._retired = set()  # slots removed by an elastic cluster shrink
         self._spawn_lock = threading.Lock()
         with _patched_env(self._env):
             for i in range(self.num_executors):
@@ -404,6 +405,10 @@ class LocalEngine:
         with self._spawn_lock:
             if self._procs[index].is_alive():
                 return False
+            if index in self._retired:
+                raise TaskError(
+                    f"executor {index} is retired (elastic cluster shrink); "
+                    "its slot is no longer part of the dispatch pool")
             if self._respawns >= self._respawn_budget:
                 raise TaskError(
                     f"executor {index} died and the respawn budget "
@@ -434,12 +439,34 @@ class LocalEngine:
     def ensure_executors(self):
         """Respawn every dead executor; returns the respawned indices.
         Used by cluster recovery to heal the pool before relaunching
-        nodes."""
+        nodes.  Raises ``TaskError`` when the respawn budget is
+        exhausted — elastic recovery (``cluster.run(min_executors=k)``)
+        catches it and re-forms the cluster over ``alive_executors()``
+        instead."""
         respawned = []
         for i, p in enumerate(self._procs):
+            if i in self._retired:
+                continue
             if not p.is_alive() and self._respawn_executor(i):
                 respawned.append(i)
         return respawned
+
+    def alive_executors(self):
+        """Sorted indices of executor processes currently alive — the
+        surviving pool an elastic recovery re-forms the cluster over."""
+        return sorted(i for i, p in enumerate(self._procs) if p.is_alive())
+
+    def retire_executors(self, indices):
+        """Replace the set of slots excluded from the dispatch pool
+        (elastic cluster shrink: ``cluster._resize_cluster``).  Retired
+        slots are skipped by spread dispatch and never respawned; a
+        later ``retire_executors([])`` — the pool healed and the
+        cluster re-grew — restores them."""
+        self._retired = {int(i) for i in indices}
+        telemetry.event("engine/retire", retired=sorted(self._retired))
+        if self._retired:
+            logger.warning("engine: retired executor slot(s) %s",
+                           sorted(self._retired))
 
     # -- engine contract ------------------------------------------------------
     @property
@@ -534,7 +561,12 @@ class LocalEngine:
             if placement is not None and task_id < len(placement):
                 target = placement[task_id] % self.num_executors
             elif spread:
-                target = task_id % self.num_executors
+                # retired slots (elastic shrink) are out of the pool
+                pool = [i for i in range(self.num_executors)
+                        if i not in self._retired]
+                if not pool:
+                    raise TaskError("all executor slots are retired")
+                target = pool[task_id % len(pool)]
             else:
                 self._shared_inbox.put(msg)
                 return

@@ -188,21 +188,47 @@ class TFNodeContext:
             self.mgr, train_mode, qname_in, qname_out, input_mapping, metrics
         )
 
-    def restore_latest(self, ckpt_dir):
+    def restore_latest(self, ckpt_dir, target_shardings=None):
         """(tree, start_step) from the newest checkpoint in ``ckpt_dir``
         regardless of who wrote it (npz or orbax layouts; (None, 0) when
         empty) — the auto-resume half of ``cluster.run(restarts=N)``:
         training mains call this at startup, so a relaunched incarnation
-        continues from where the dead one last saved."""
+        continues from where the dead one last saved.
+
+        ``target_shardings`` (pytree of ``Sharding`` or callable
+        ``tree -> shardings``) re-places the restored leaves under this
+        incarnation's mesh — required after an elastic resize, where the
+        checkpoint was written under a different topology
+        (``utils/checkpoint.restore_any``, docs/elastic.md)."""
         from tensorflowonspark_tpu.utils import checkpoint as _ckpt
 
-        tree, step = _ckpt.restore_any(ckpt_dir)
+        tree, step = _ckpt.restore_any(ckpt_dir,
+                                       target_shardings=target_shardings)
         telemetry.event("node/resume", step=step, epoch=self.epoch,
-                        found=tree is not None)
+                        found=tree is not None,
+                        resharded=target_shardings is not None)
         if tree is not None:
             logger.info("node %s:%s resuming from step %d (epoch %d)",
                         self.job_name, self.task_index, step, self.epoch)
         return tree, step
+
+    def elastic_runtime(self, mesh_axes, devices=None, global_batch=0,
+                        accum_axis="data"):
+        """An :class:`elastic.ElasticRuntime` for this node: the logical
+        mesh shape ``mesh_axes`` resolved over this incarnation's
+        devices (default: all devices visible after
+        ``jax_initialize``).  A relaunched node on a shrunken cluster
+        gets a smaller physical mesh for the SAME logical shape, with
+        gradient accumulation making up the difference
+        (docs/elastic.md)."""
+        from tensorflowonspark_tpu import elastic
+
+        return elastic.from_context(
+            self,
+            elastic.TrainSpec(mesh_axes=dict(mesh_axes),
+                              global_batch=int(global_batch),
+                              accum_axis=accum_axis),
+            devices=devices)
 
     def distributed_env(self):
         env = _distributed_env(self.cluster_info)

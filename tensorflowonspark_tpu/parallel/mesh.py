@@ -33,6 +33,21 @@ AXIS_ORDER = ("pp", "data", "fsdp", "seq", "ep", "model")
 AXIS_ALIASES = {"pipe": "pp", "expert": "ep"}
 
 
+def canonical_axes(axes):
+    """Alias-canonicalized copy of an axes dict ({'pipe': 2} -> {'pp': 2});
+    raises when two spellings collide after canonicalization.  Shared by
+    ``MeshSpec.resolve`` and the elastic virtual-device layer
+    (``elastic/virtual.py``), which canonicalizes logical shapes that
+    have no device count to resolve against yet."""
+    sizes = {AXIS_ALIASES.get(k, k): v for k, v in axes.items()}
+    if len(sizes) != len(axes):
+        raise ValueError(
+            f"mesh axes {list(axes)} collide after alias "
+            f"canonicalization ({AXIS_ALIASES})"
+        )
+    return sizes
+
+
 @dataclass
 class MeshSpec:
     """Named axis sizes; -1 at most once to absorb remaining devices."""
@@ -40,12 +55,7 @@ class MeshSpec:
     axes: dict = field(default_factory=dict)
 
     def resolve(self, n_devices):
-        sizes = {AXIS_ALIASES.get(k, k): v for k, v in self.axes.items()}
-        if len(sizes) != len(self.axes):
-            raise ValueError(
-                f"mesh axes {list(self.axes)} collide after alias "
-                f"canonicalization ({AXIS_ALIASES})"
-            )
+        sizes = canonical_axes(self.axes)
         unknown = [k for k, v in sizes.items() if v == -1]
         known = math.prod(v for v in sizes.values() if v != -1)
         if len(unknown) > 1:

@@ -87,6 +87,13 @@ class Reservations:
         with self._lock:
             self._reservations = []
 
+    def resize(self, required):
+        """Change how many registrations complete the cluster (elastic
+        recovery re-forms a smaller — or re-grown — incarnation over the
+        surviving executors; cluster.py:_resize_cluster)."""
+        with self._lock:
+            self.required = int(required)
+
     def done(self):
         with self._lock:
             return len(self._reservations) >= self.required
@@ -173,6 +180,18 @@ class Server(MessageSocket):
         self.done.clear()
         telemetry.event("rendezvous/epoch_reset", epoch=self.epoch)
         logger.info("rendezvous: reset to epoch %d", self.epoch)
+
+    def resize(self, required):
+        """Elastic recovery: the next incarnation completes with
+        ``required`` registrations (fewer after an unhealable executor
+        loss, back to full strength after the pool re-grew).  Call
+        before ``reset(epoch)`` relaunches the nodes."""
+        old = self.reservations.required
+        self.reservations.resize(required)
+        telemetry.event("rendezvous/resize", from_required=old,
+                        to_required=int(required))
+        logger.info("rendezvous: required registrations %d -> %d",
+                    old, int(required))
 
     def fed_partitions(self, feed="input"):
         """Sorted partition indices recorded as fully consumed for ``feed``."""

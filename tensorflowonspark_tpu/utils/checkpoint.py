@@ -235,12 +235,22 @@ def latest(ckpt_dir):
     return best_npz, _fs.join(ckpt_dir, f"ckpt-{best_npz:08d}.npz")
 
 
-def restore_any(ckpt_dir):
+def restore_any(ckpt_dir, target_shardings=None):
     """(tree, step) from the newest checkpoint regardless of format, or
     (None, 0).  The auto-resume entry point (``TFNodeContext
     .restore_latest``): a relaunched node must continue from whatever its
     dead predecessor last published, whether it saved via
-    ``save_checkpoint`` (npz) or :class:`AsyncCheckpointer` (orbax)."""
+    ``save_checkpoint`` (npz) or :class:`AsyncCheckpointer` (orbax).
+
+    Without ``target_shardings`` leaves restore as host numpy with NO
+    placement contract — fine for single-device resumes, wrong for a
+    mesh.  ``target_shardings`` makes placement explicit (the reshard
+    step of elastic recovery, docs/elastic.md): a pytree of ``Sharding``
+    matching the restored tree, or a callable ``tree -> shardings``
+    derived from the restored structure (e.g. ``lambda t:
+    fsdp_sharding(mesh, t)``).  The checkpoint may have been written
+    under a DIFFERENT mesh shape: restore is host-side either way, so
+    re-placement works across topologies (``elastic/reshard.py``)."""
     steps = _steps_by_format(ckpt_dir)
     best_npz = max(steps["npz"]) if steps["npz"] else -1
     best_orbax = max(steps["orbax"]) if steps["orbax"] else -1
@@ -249,10 +259,18 @@ def restore_any(ckpt_dir):
     if best_orbax >= best_npz:
         ckpt = AsyncCheckpointer(ckpt_dir)
         try:
-            return ckpt.restore_latest()
+            tree, step = ckpt.restore_latest()
         finally:
             ckpt.close()
-    return restore_latest(ckpt_dir)
+    else:
+        tree, step = restore_latest(ckpt_dir)
+    if tree is not None and target_shardings is not None:
+        # function import: the elastic package re-exports reshard() the
+        # function over the reshard module attribute
+        from tensorflowonspark_tpu.elastic.reshard import reshard
+
+        tree = reshard(tree, target_shardings)
+    return tree, step
 
 
 class AsyncCheckpointer:

@@ -125,6 +125,20 @@ CATALOG = {
         "histogram", "Checkpoint save latency, milliseconds."),
     "tfos_checkpoint_restore_ms": (
         "histogram", "Checkpoint restore latency, milliseconds."),
+    # elastic SPMD runtime (elastic/)
+    "tfos_elastic_resizes_total": (
+        "counter", "Mesh/cluster elastic resizes, by scope "
+                   "(runtime|cluster)."),
+    "tfos_elastic_mesh_devices": (
+        "gauge", "Physical devices in the current elastic mesh."),
+    "tfos_elastic_virtual_devices": (
+        "gauge", "Virtual devices (logical mesh size) of the TrainSpec."),
+    "tfos_elastic_accum_steps": (
+        "gauge", "Gradient-accumulation steps folding virtual onto "
+                 "physical devices."),
+    "tfos_elastic_reshard_ms": (
+        "histogram", "Train-state reshard latency (host round-trip), "
+                     "milliseconds."),
 }
 
 

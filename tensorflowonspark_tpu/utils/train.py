@@ -15,6 +15,24 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def split_microbatches(batch, accum_steps):
+    """Reshape every leaf of ``batch`` (a tuple/pytree of arrays with a
+    shared leading batch dim) to ``[accum_steps, b/accum_steps, ...]``
+    for a ``lax.scan`` over microbatches.  Raises when the leading dim
+    is not divisible — the elastic virtual layer sizes global batches so
+    this always divides on any divisor topology (docs/elastic.md)."""
+
+    def split(x):
+        if x.shape[0] % accum_steps:
+            raise ValueError(
+                f"batch dim {x.shape[0]} not divisible by "
+                f"accum_steps={accum_steps}")
+        return x.reshape(accum_steps, x.shape[0] // accum_steps,
+                         *x.shape[1:])
+
+    return jax.tree.map(split, batch)
+
+
 def accumulated_value_and_grad(loss_fn, accum_steps, has_aux=False,
                                carry_aux=False):
     """``jax.value_and_grad`` with microbatch accumulation.
@@ -48,15 +66,7 @@ def accumulated_value_and_grad(loss_fn, accum_steps, has_aux=False,
         if carry_aux and init_aux is None:
             raise ValueError("carry_aux=True requires init_aux=...")
 
-        def split(x):
-            if x.shape[0] % accum_steps:
-                raise ValueError(
-                    f"batch dim {x.shape[0]} not divisible by "
-                    f"accum_steps={accum_steps}")
-            return x.reshape(accum_steps, x.shape[0] // accum_steps,
-                             *x.shape[1:])
-
-        micro = jax.tree.map(split, batch)
+        micro = split_microbatches(batch, accum_steps)
 
         def body(carry, mb):
             loss_sum, aux_prev, grad_sum = carry

@@ -1,0 +1,242 @@
+"""Elastic SPMD runtime (fast lane): virtual-device algebra, runtime
+resize, reshard placement/value fidelity, cross-mesh checkpoint
+round-trips, and the cluster/rendezvous resize plumbing (docs/elastic.md).
+The kill-one-executor recovery e2e is test_elastic_e2e.py (slow lane)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tensorflowonspark_tpu import elastic
+from tensorflowonspark_tpu.cluster import _elastic_template
+from tensorflowonspark_tpu.elastic.virtual import virtualize
+from tensorflowonspark_tpu.utils import checkpoint as ckpt
+
+pytestmark = pytest.mark.elastic
+
+
+# ---------------------------------------------------------------- virtualize
+
+def test_virtualize_identity_fold(eight_devices):
+    layout = virtualize({"data": 4, "fsdp": 2}, eight_devices)
+    assert layout.accum_steps == 1
+    assert layout.physical == layout.logical == {"data": 4, "fsdp": 2}
+    assert dict(layout.mesh.shape) == {"data": 4, "fsdp": 2}
+
+
+def test_virtualize_folds_deficit_into_accum_axis(eight_devices):
+    layout = virtualize({"data": 8, "fsdp": 2}, eight_devices)
+    assert layout.accum_steps == 2
+    assert layout.physical == {"data": 4, "fsdp": 2}
+    assert layout.logical == {"data": 8, "fsdp": 2}
+    assert layout.n_virtual == 16 and layout.n_physical == 8
+    # non-accum axes never shrink: fsdp stays at its logical size
+    assert layout.physical["fsdp"] == layout.logical["fsdp"]
+
+
+def test_virtualize_canonicalizes_aliases(eight_devices):
+    layout = virtualize({"pipe": 2, "expert": 4}, eight_devices,
+                        accum_axis="expert")
+    assert layout.logical == {"pp": 2, "ep": 4}
+    assert layout.accum_axis == "ep"
+
+
+def test_virtualize_rejects_non_divisor_topology(eight_devices):
+    with pytest.raises(ValueError, match="divisor"):
+        virtualize({"data": 8}, eight_devices[:3])
+
+
+def test_virtualize_rejects_minus_one(eight_devices):
+    with pytest.raises(ValueError, match="fully specified"):
+        virtualize({"data": -1}, eight_devices)
+
+
+def test_virtualize_rejects_missing_accum_axis(eight_devices):
+    with pytest.raises(ValueError, match="no 'data' axis"):
+        virtualize({"fsdp": 8}, eight_devices[:4])
+
+
+def test_virtualize_rejects_indivisible_accum_axis(eight_devices):
+    # factor 4 cannot fold into data=2
+    with pytest.raises(ValueError, match="cannot fold"):
+        virtualize({"data": 2, "model": 4}, eight_devices[:2])
+
+
+def test_virtualize_microbatch_schedule(eight_devices):
+    layout = virtualize({"data": 8}, eight_devices[:4])
+    assert layout.accum_steps == 2
+    assert layout.microbatch(256) == 128
+    with pytest.raises(ValueError, match="not divisible"):
+        layout.microbatch(255)
+
+
+def test_virtualize_accumulated_grad_matches_flat(eight_devices):
+    """The fold is numerically invisible: accumulated value_and_grad over
+    the layout's microbatches equals the flat gradient on the full batch."""
+    layout = virtualize({"data": 8}, eight_devices[:4])
+    w = jnp.ones((4,))
+    batch = jnp.arange(32.0).reshape(8, 4)
+
+    def loss_fn(w, b):
+        return jnp.mean((b @ w) ** 2)
+
+    flat_l, flat_g = jax.value_and_grad(loss_fn)(w, batch)
+    acc_l, acc_g = layout.value_and_grad(loss_fn)(w, batch)
+    np.testing.assert_allclose(acc_l, flat_l, rtol=1e-5)
+    np.testing.assert_allclose(acc_g, flat_g, rtol=1e-5)
+
+
+# ------------------------------------------------------------ ElasticRuntime
+
+def _toy_state(key=0):
+    params = {"w": jnp.asarray(
+        np.random.default_rng(key).random((128, 64), np.float32))}
+    state = {"step": jnp.zeros((), jnp.int32)}
+    opt_state = optax.sgd(0.1).init(params)
+    return params, state, opt_state
+
+
+def test_runtime_resize_refolds_same_logical_shape(eight_devices):
+    rt = elastic.ElasticRuntime(
+        elastic.TrainSpec({"data": 8}, global_batch=64), devices=eight_devices)
+    assert rt.generation == 0
+    assert rt.layout.accum_steps == 1
+    assert rt.batch_schedule() == {
+        "global": 64, "microbatch": 64, "per_device": 8, "accum_steps": 1}
+
+    rt.resize(devices=eight_devices[:4])  # shrink: 8 virtual on 4 devices
+    assert rt.generation == 1
+    assert rt.layout.accum_steps == 2
+    assert dict(rt.mesh.shape) == {"data": 4}
+    assert rt.batch_schedule() == {
+        "global": 64, "microbatch": 32, "per_device": 8, "accum_steps": 2}
+
+    rt.resize(devices=eight_devices)  # re-grow back to the full pool
+    assert rt.generation == 2
+    assert rt.layout.accum_steps == 1
+
+
+def test_runtime_reshard_moves_state_and_keeps_values(eight_devices):
+    rt = elastic.ElasticRuntime(
+        elastic.TrainSpec({"data": 4, "fsdp": 2}), devices=eight_devices)
+    params, state, opt_state = _toy_state()
+    (params, state, opt_state), _ = rt.shard_train_state(
+        params, state, opt_state)
+    before = np.asarray(params["w"])
+
+    rt.resize(devices=eight_devices[:4])
+    (params, state, opt_state), (p_sh, _s, _o) = rt.reshard_train_state(
+        params, state, opt_state)
+    np.testing.assert_array_equal(np.asarray(params["w"]), before)
+    new_devs = set(rt.mesh.devices.flat)
+    assert set(params["w"].sharding.device_set) <= new_devs
+    assert p_sh["w"].mesh is rt.mesh
+
+
+def test_runtime_reshard_default_shardings(eight_devices):
+    rt = elastic.ElasticRuntime(
+        elastic.TrainSpec({"data": 2, "fsdp": 2}), devices=eight_devices[:4])
+    tree = {"w": jnp.ones((128, 64))}
+    placed = rt.reshard(tree)
+    np.testing.assert_array_equal(np.asarray(placed["w"]), 1.0)
+    assert set(placed["w"].sharding.device_set) <= set(rt.mesh.devices.flat)
+
+
+def test_runtime_trainspec_coercion_and_metrics(eight_devices, monkeypatch):
+    from tensorflowonspark_tpu.utils import metrics_registry
+
+    monkeypatch.setenv(metrics_registry.PORT_ENV, "0")
+    metrics_registry.reset()
+    try:
+        rt = elastic.ElasticRuntime({"data": 8}, devices=eight_devices)
+        assert rt.spec.mesh_axes == {"data": 8}
+        rt.resize(devices=eight_devices[:4])
+        snap = metrics_registry.snapshot()
+    finally:
+        metrics_registry.reset()
+
+    def value(name):
+        return snap[name]["series"][0]["value"]
+
+    assert value("tfos_elastic_mesh_devices") == 4
+    assert value("tfos_elastic_virtual_devices") == 8
+    assert value("tfos_elastic_accum_steps") == 2
+    resizes = snap["tfos_elastic_resizes_total"]["series"][0]
+    assert resizes["labels"] == {"scope": "runtime"}
+    assert resizes["value"] == 1
+
+
+# -------------------------------------------------- cross-mesh checkpointing
+
+def test_checkpoint_cross_mesh_round_trip(tmp_path, eight_devices):
+    """Save under an 8-device fold, restore under a 4-device fold: values
+    identical, placement on the new mesh (the resize-resume path)."""
+    rt8 = elastic.ElasticRuntime(
+        elastic.TrainSpec({"data": 4, "fsdp": 2}), devices=eight_devices)
+    params, state, opt_state = _toy_state()
+    (params, _state, _opt), _ = rt8.shard_train_state(
+        params, state, opt_state)
+    ckpt.save_checkpoint(str(tmp_path), params, step=3)
+
+    rt4 = elastic.ElasticRuntime(
+        elastic.TrainSpec({"data": 4, "fsdp": 2}), devices=eight_devices[:4])
+    restored, step = rt4.restore(str(tmp_path))
+    assert step == 3
+    np.testing.assert_array_equal(
+        np.asarray(restored["w"]), np.asarray(params["w"]))
+    assert set(restored["w"].sharding.device_set) <= set(
+        rt4.mesh.devices.flat)
+
+
+def test_restore_any_explicit_target_shardings(tmp_path, eight_devices):
+    from tensorflowonspark_tpu.parallel import fsdp_sharding, make_mesh
+
+    params = {"w": jnp.asarray(
+        np.random.default_rng(1).random((128, 64), np.float32))}
+    ckpt.save_checkpoint(str(tmp_path), params, step=11)
+
+    mesh4 = make_mesh({"data": 2, "fsdp": 2}, devices=eight_devices[:4])
+    tree, step = ckpt.restore_any(
+        str(tmp_path),
+        target_shardings=lambda t: fsdp_sharding(mesh4, t))
+    assert step == 11
+    np.testing.assert_array_equal(
+        np.asarray(tree["w"]), np.asarray(params["w"]))
+    assert set(tree["w"].sharding.device_set) <= set(mesh4.devices.flat)
+    # without target_shardings the old host-numpy behavior is unchanged
+    plain, _ = ckpt.restore_any(str(tmp_path))
+    assert isinstance(plain["w"], np.ndarray)
+
+
+# ------------------------------------------- cluster/rendezvous resize bits
+
+def test_elastic_template_promotes_coordinator():
+    t0 = {"chief": [0], "worker": [1, 2, 3], "ps": [4]}
+    assert _elastic_template(t0, [1, 2, 3, 4]) == {
+        "chief": [1], "worker": [2, 3], "ps": [4]}
+
+
+def test_elastic_template_drops_empty_jobs():
+    t0 = {"chief": [0], "worker": [1, 2], "ps": [3], "evaluator": [4]}
+    assert _elastic_template(t0, [0, 2]) == {"chief": [0], "worker": [2]}
+
+
+def test_elastic_template_regrow_is_identity():
+    t0 = {"chief": [0], "worker": [1, 2, 3]}
+    assert _elastic_template(t0, [0, 1, 2, 3]) == t0
+
+
+def test_rendezvous_resize_changes_required():
+    from tensorflowonspark_tpu import rendezvous
+
+    server = rendezvous.Server(3)
+    try:
+        server.start()
+        assert server.reservations.required == 3
+        server.resize(2)
+        assert server.reservations.required == 2
+        assert server.reservations.remaining() == 2
+    finally:
+        server.stop()

@@ -36,3 +36,59 @@ def test_make_mesh_accepts_aliases(eight_devices):
 def test_minus_one_absorbs_remainder(eight_devices):
     mesh = make_mesh({"model": 2, "data": -1}, devices=eight_devices)
     assert dict(mesh.shape) == {"data": 4, "model": 2}
+
+
+def test_resolve_rejects_multiple_minus_ones():
+    with pytest.raises(ValueError, match="at most one"):
+        MeshSpec({"data": -1, "model": -1}).resolve(8)
+
+
+def test_resolve_rejects_non_divisible_remainder():
+    # fixed axes product (3) does not divide the device count (8)
+    with pytest.raises(ValueError, match="not divisible"):
+        MeshSpec({"model": 3, "data": -1}).resolve(8)
+
+
+def test_resolve_rejects_fixed_product_mismatch():
+    with pytest.raises(ValueError, match="devices"):
+        MeshSpec({"data": 4, "model": 4}).resolve(8)
+
+
+def test_resolve_single_axis_degenerate():
+    assert MeshSpec({"data": 1}).resolve(1) == {"data": 1}
+    assert MeshSpec({"data": -1}).resolve(1) == {"data": 1}
+
+
+def test_fsdp_sharding_small_leaves_replicate(eight_devices):
+    import numpy as np
+
+    from tensorflowonspark_tpu.parallel import fsdp_sharding
+
+    mesh = make_mesh({"data": 4, "fsdp": 2}, devices=eight_devices)
+    tree = {
+        "tiny": np.zeros((8, 8), np.float32),        # < min_shard_elems
+        "big": np.zeros((130, 64), np.float32),      # largest dim % 2 == 0
+        "odd": np.zeros((65, 65), np.float32),       # no divisible dim
+    }
+    sh = fsdp_sharding(mesh, tree)
+    assert sh["tiny"].spec == jax_pspec()
+    assert sh["big"].spec == jax_pspec("fsdp", None)
+    assert sh["odd"].spec == jax_pspec()
+
+
+def test_fsdp_sharding_prefers_largest_divisible_dim(eight_devices):
+    import numpy as np
+
+    from tensorflowonspark_tpu.parallel import fsdp_sharding
+
+    mesh = make_mesh({"fsdp": 8}, devices=eight_devices)
+    # largest dim (100) is not divisible by 8; the smaller (64) is
+    leaf = np.zeros((100, 64), np.float32)
+    sh = fsdp_sharding(mesh, {"w": leaf}, min_shard_elems=1)
+    assert sh["w"].spec == jax_pspec(None, "fsdp")
+
+
+def jax_pspec(*entries):
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec(*entries)
