@@ -336,6 +336,180 @@ def loss_fn(params, tokens, cfg: Config, *, attn_fn=None, remat=False,
     return jnp.sum(nll * vf) / jnp.maximum(jnp.sum(vf), 1.0)
 
 
+# ---------------------------------------------------------------------------
+# Incremental (KV-cached) decode — the serving/decode tier's model half.
+#
+# No reference counterpart (the reference delegates all inference to TF
+# Serving, SURVEY.md §2.2): ``prefill`` runs the prompt once and hands back
+# the per-layer keys/values, ``decode_step`` extends every active slot of a
+# preallocated slot-paged cache (serving/decode/kvcache.py) by one token.
+# Both reuse the exact ``_layer_apply`` arithmetic (rmsnorm / rope / gelu
+# MLP / f32-accumulated matmuls), so a KV-cached greedy decode is
+# token-identical to re-running ``apply`` on the growing sequence —
+# ``greedy_decode_reference`` below is that oracle, and
+# tests/test_decode.py gates the parity.
+# ---------------------------------------------------------------------------
+
+_NEG_INF = -1e30  # finite mask fill (ops.attention convention: never -inf)
+
+
+def _layer_apply_kv(p, x, cfg, rope, attn_fn):
+    """``_layer_apply`` that also returns the layer's rope-rotated keys
+    and values in cache layout [B, H, S, D].  Keys are cached
+    POST-rotation, so a cached entry never needs its position again."""
+    b, s, dim = x.shape
+    h, hd = cfg.n_heads, cfg.head_dim
+    cos, sin, positions = rope
+
+    y = ops.rmsnorm_reference(x, p["ln1"])
+    qkv = _matmul(y, p["wqkv"]).reshape(b, s, 3, h, hd)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    q = ops.apply_rope(q, cos, sin, positions=positions)
+    k = ops.apply_rope(k, cos, sin, positions=positions)
+    attn = attn_fn(q, k, v).reshape(b, s, dim)
+    x = x + _matmul(attn, p["wo"])
+
+    y = ops.rmsnorm_reference(x, p["ln2"])
+    y = _matmul(jax.nn.gelu(_matmul(y, p["w1"])), p["w2"])
+    return x + y, k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3)
+
+
+def prefill(params, tokens, cfg: Config, *, lengths=None, attn_fn=None):
+    """Prompt pass for incremental decode.
+
+    ``tokens`` [B, T] int32 right-padded prompts, ``lengths`` [B] true
+    prompt lengths (default: all T).  Returns ``(logits, k, v)`` —
+    ``logits`` [B, vocab] float32 at each row's final REAL position (the
+    next-token distribution), ``k``/``v`` [B, n_layers, n_heads, T,
+    head_dim] in the slot-cache layout (keys rope-rotated).
+
+    Padded tail positions produce garbage k/v, but they are never read:
+    causal masking keeps them out of the real positions' attention here,
+    and ``decode_step`` masks to ``position <= cursor`` while its next
+    write lands AT the cursor, overwriting the first padded column.
+    """
+    if attn_fn is None:
+        base = (ops.flash_attention if cfg.attn_impl == "flash"
+                else ops.mha_reference)
+        attn_fn = functools.partial(base, causal=True)
+    dtype = cfg.compute_dtype
+    b, t = tokens.shape
+    x = params["embed"].astype(dtype)[tokens]
+    cos, sin = ops.rope_angles(t, cfg.head_dim, cfg.rope_base)
+    rope = (cos, sin, None)
+
+    def body(x, layer_params):
+        x, k, v = _layer_apply_kv(layer_params, x, cfg, rope, attn_fn)
+        return x, (k, v)
+
+    x, (k, v) = lax.scan(body, x, params["layers"])
+    x = ops.rmsnorm_reference(x, params["ln_f"])
+    if lengths is None:
+        last = jnp.full((b,), t - 1, jnp.int32)
+    else:
+        last = jnp.asarray(lengths, jnp.int32) - 1
+    x_last = jnp.take_along_axis(
+        x, jnp.clip(last, 0, t - 1)[:, None, None], axis=1)[:, 0]
+    logits = _matmul(x_last, params["head"]).astype(jnp.float32)
+    # scan stacks layers leading: [L, B, H, T, D] -> [B, L, H, T, D]
+    return logits, k.transpose(1, 0, 2, 3, 4), v.transpose(1, 0, 2, 3, 4)
+
+
+def _cache_write(cache_l, new, cursors):
+    """Write one [H, D] entry per slot at its cursor column:
+    ``cache_l`` [S, H, M, D], ``new`` [S, H, D], ``cursors`` [S]."""
+
+    def one(c, n, p):
+        return lax.dynamic_update_slice(c, n[:, None, :], (0, p, 0))
+
+    return jax.vmap(one)(cache_l, new, cursors)
+
+
+def decode_step(params, tokens, cfg: Config, cache_k, cache_v, lengths):
+    """One fused continuous-batching decode iteration over ALL slots.
+
+    ``tokens`` [S] int32 — each slot's incoming token (sitting at
+    position ``lengths[s]``); ``cache_k``/``cache_v``
+    [S, n_layers, n_heads, max_seq, head_dim] (kvcache.SlotKVCache
+    arrays, keys rope-rotated); ``lengths`` [S] int32 — tokens already
+    resident per slot.  Writes each slot's new k/v at its cursor,
+    attends over ``position <= cursor`` only, and returns
+    ``(logits [S, vocab] float32, new_cache_k, new_cache_v)``.
+
+    Free/padding slots are numerically inert by construction: with
+    length 0 and token 0 a free slot attends exactly its own position-0
+    cache column — finite garbage confined to that slot's logits row,
+    which the scheduler discards.  No operation mixes slots.
+    """
+    dtype = cfg.compute_dtype
+    h, hd = cfg.n_heads, cfg.head_dim
+    s_slots = tokens.shape[0]
+    m = cache_k.shape[3]
+    lengths = jnp.asarray(lengths, jnp.int32)
+    cursors = jnp.clip(lengths, 0, m - 1)
+    pos = cursors[:, None]                              # [S, 1] rope rows
+    scale = 1.0 / (hd ** 0.5)
+    # [S, 1, M] -> broadcasts over heads in the masked-score add below
+    kv_mask = jnp.arange(m)[None, None, :] <= cursors[:, None, None]
+
+    x = params["embed"].astype(dtype)[tokens][:, None, :]   # [S, 1, dim]
+    cos, sin = ops.rope_angles(m, cfg.head_dim, cfg.rope_base)
+
+    def body(carry, inp):
+        x, = carry
+        p, ck_l, cv_l = inp                     # ck_l/cv_l: [S, H, M, D]
+        y = ops.rmsnorm_reference(x, p["ln1"])
+        qkv = _matmul(y, p["wqkv"]).reshape(s_slots, 1, 3, h, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q = ops.apply_rope(q, cos, sin, positions=pos)
+        k = ops.apply_rope(k, cos, sin, positions=pos)
+        ck_l = _cache_write(ck_l, k[:, 0], cursors)
+        cv_l = _cache_write(cv_l, v[:, 0], cursors)
+        # f32 masked softmax, ops.mha_reference convention
+        qf = q[:, 0].astype(jnp.float32)                      # [S, H, D]
+        scores = jnp.einsum(
+            "shd,shmd->shm", qf, ck_l.astype(jnp.float32)) * scale
+        scores = jnp.where(kv_mask, scores, _NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum(
+            "shm,shmd->shd", probs, cv_l.astype(jnp.float32))
+        attn = attn.astype(dtype).reshape(s_slots, 1, h * hd)
+        x = x + _matmul(attn, p["wo"])
+        y = ops.rmsnorm_reference(x, p["ln2"])
+        y = _matmul(jax.nn.gelu(_matmul(y, p["w1"])), p["w2"])
+        return (x + y,), (ck_l, cv_l)
+
+    # scan over layers: cache arrives [S, L, ...] -> scan axis leading
+    (x,), (new_k, new_v) = lax.scan(
+        body, (x,),
+        (params["layers"],
+         cache_k.transpose(1, 0, 2, 3, 4), cache_v.transpose(1, 0, 2, 3, 4)))
+    x = ops.rmsnorm_reference(x, params["ln_f"])
+    logits = _matmul(x[:, 0], params["head"]).astype(jnp.float32)
+    return (logits,
+            new_k.transpose(1, 0, 2, 3, 4),
+            new_v.transpose(1, 0, 2, 3, 4))
+
+
+def greedy_decode_reference(params, prompt, cfg: Config, *, max_tokens,
+                            eos_id=None, attn_fn=None):
+    """Full-recompute greedy decode — the KV-cache parity oracle
+    (tests/test_decode.py): each step re-runs ``apply`` on the whole
+    growing sequence and argmaxes the final position.  O(T²) per token;
+    test-sized models only."""
+    toks = [int(t) for t in prompt]
+    out = []
+    for _ in range(int(max_tokens)):
+        logits = apply(params, jnp.asarray([toks], jnp.int32), cfg,
+                       attn_fn=attn_fn)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        out.append(nxt)
+        toks.append(nxt)
+        if eos_id is not None and nxt == int(eos_id):
+            break
+    return out
+
+
 def zigzag_lm_batch(tokens, perm):
     """Prepare a contiguous-order LM batch for zigzag training:
     returns ``(tokens_p, labels_p, positions)`` where ``tokens_p`` is

@@ -97,6 +97,15 @@ def node_summary(snap):
         sq = _metric_gauge(snap, "tfos_serve_queue_depth")
         if sq is not None:
             out["queue_depth"] = sq
+    dh = _metric_hist(snap, "tfos_decode_ttft_ms")
+    if dh:
+        out["decode_ttft_p99_ms"] = _round(
+            metrics_registry.quantile(dh, 0.99))
+        out["decode_tokens"] = _metric_total(
+            snap, "tfos_decode_tokens_total")
+        occ = _metric_gauge(snap, "tfos_decode_slot_occupancy")
+        if occ is not None:
+            out["decode_slots_busy"] = occ
     return {k: v for k, v in out.items() if v is not None}
 
 

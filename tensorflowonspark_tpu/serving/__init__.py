@@ -12,15 +12,26 @@ Pieces:
   - :mod:`~tensorflowonspark_tpu.serving.replicas` — supervised model
     replicas with least-loaded dispatch and checkpoint hot-reload;
   - :mod:`~tensorflowonspark_tpu.serving.server` — in-process Client,
-    stdlib HTTP endpoint, SLO stats, ``tfos-serve`` CLI.
+    stdlib HTTP endpoint, SLO stats, ``tfos-serve`` CLI;
+  - :mod:`~tensorflowonspark_tpu.serving.decode` — continuous-batching
+    autoregressive decode (slot-paged KV cache, iteration-level
+    scheduler, open-loop load generator).
 """
 
 from tensorflowonspark_tpu.serving.batcher import (  # noqa: F401
     MicroBatcher,
     Overloaded,
+    bucket_seq,
     bucket_size,
     pad_columns,
     pad_rows,
+    pad_seq,
+)
+from tensorflowonspark_tpu.serving.decode import (  # noqa: F401
+    DecodeEngine,
+    DecodeSpec,
+    PendingSession,
+    run_open_loop,
 )
 from tensorflowonspark_tpu.serving.replicas import (  # noqa: F401
     ModelSpec,
@@ -28,6 +39,7 @@ from tensorflowonspark_tpu.serving.replicas import (  # noqa: F401
 )
 from tensorflowonspark_tpu.serving.server import (  # noqa: F401
     Client,
+    DecodeStats,
     Server,
     SLOStats,
     serve_http,

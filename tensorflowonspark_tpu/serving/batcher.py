@@ -77,6 +77,20 @@ def bucket_size(n, cap=None):
     return min(b, cap)
 
 
+def bucket_seq(n, cap):
+    """Smallest power of two >= n, capped at ``cap`` (the cap itself is
+    always a legal bucket).  Same rounding as :func:`bucket_size` but on
+    a sequence axis: variable-length prompts compile one executable per
+    bucket instead of one per distinct length (or all at max_seq)."""
+    cap = int(cap)
+    if n >= cap:
+        return cap
+    b = 1
+    while b < n:
+        b <<= 1
+    return min(b, cap)
+
+
 def pad_rows(arr, target):
     """Pad ``arr`` along axis 0 up to ``target`` rows by edge-replication
     (real rows repeated, so padded compute stays numerically in-domain —
@@ -92,6 +106,32 @@ def pad_rows(arr, target):
     if n == 0:
         raise ValueError("cannot pad an empty batch (no row to replicate)")
     widths = [(0, target - n)] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, widths, mode="edge")
+
+
+def pad_seq(arr, target, axis=0):
+    """Pad ``arr`` along a SEQUENCE axis up to ``target`` by
+    edge-replication (the last real step repeated, so padded positions
+    stay in-domain — token ids remain valid vocabulary entries).
+
+    Attention-mask safety is split with the model: edge values keep the
+    compute finite/in-domain, and the consumer masks padded positions
+    using the true lengths the batcher ships alongside
+    (``MicroBatcher(seq_axis=...)`` adds a ``_seq_len`` column;
+    ``transformer.prefill(lengths=...)`` reads its final REAL position
+    and never attends past it causally)."""
+    arr = np.asarray(arr)
+    if arr.ndim <= axis:
+        raise ValueError(f"array rank {arr.ndim} has no axis {axis}")
+    n = arr.shape[axis]
+    if n == target:
+        return arr
+    if n > target:
+        raise ValueError(f"cannot pad seq {n} down to {target}")
+    if n == 0:
+        raise ValueError("cannot pad an empty sequence")
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, target - n)
     return np.pad(arr, widths, mode="edge")
 
 
@@ -231,12 +271,23 @@ class Batch:
         return True
 
 
-def _signature(example):
-    """Shape/dtype signature grouping co-batchable examples."""
-    return tuple(
-        (k, tuple(np.shape(v)), str(np.asarray(v).dtype))
-        for k, v in sorted(example.items())
-    )
+def _signature(example, seq_axis=None, seq_cap=None):
+    """Shape/dtype signature grouping co-batchable examples.
+
+    With ``seq_axis`` set, that per-example axis's length is replaced by
+    its power-of-two bucket (:func:`bucket_seq`), so examples of
+    different sequence lengths that round to the same bucket co-batch —
+    ``_flush`` pads each member up to the bucket (:func:`pad_seq`).
+    """
+    sig = []
+    for k, v in sorted(example.items()):
+        shape = tuple(np.shape(v))
+        if seq_axis is not None and len(shape) > seq_axis:
+            shape = (shape[:seq_axis]
+                     + (bucket_seq(shape[seq_axis], seq_cap),)
+                     + shape[seq_axis + 1:])
+        sig.append((k, shape, str(np.asarray(v).dtype)))
+    return tuple(sig)
 
 
 _STOP = object()
@@ -249,12 +300,21 @@ class MicroBatcher:
 
     def __init__(self, dispatch, max_batch=None, max_delay_ms=None,
                  queue_max=None, observer=None, batch_observer=None,
-                 on_shed=None):
+                 on_shed=None, seq_axis=None, seq_cap=None):
         self._dispatch = dispatch
         self.max_batch = max_batch or max_batch_default()
         self.max_delay_s = (max_delay_ms_default() if max_delay_ms is None
                             else float(max_delay_ms)) / 1e3
         self.queue_max = queue_max or queue_max_default()
+        # sequence bucketing (docs/serving.md): group by power-of-two
+        # bucket of per-example axis ``seq_axis`` (axis seq_axis+1 of the
+        # batched tensor), pad members up by edge-replication, and ship
+        # the true lengths as a ``_seq_len`` int32 column so the model
+        # can mask the padding (attention-mask-safe by contract).
+        self.seq_axis = seq_axis
+        self.seq_cap = seq_cap
+        if seq_axis is not None and seq_cap is None:
+            raise ValueError("seq_axis requires seq_cap (the max length)")
         self._observer = observer
         self._batch_observer = batch_observer
         self._on_shed = on_shed
@@ -329,14 +389,33 @@ class MicroBatcher:
         """Stack one gathered wave into per-signature bucket batches."""
         groups = {}
         for req in reqs:
-            groups.setdefault(_signature(req.example), []).append(req)
-        for members in groups.values():
+            groups.setdefault(
+                _signature(req.example, self.seq_axis, self.seq_cap),
+                []).append(req)
+        for sig, members in groups.items():
             t0 = time.perf_counter()
             try:
-                cols = {
-                    k: np.stack([m.example[k] for m in members])
-                    for k in members[0].example
-                }
+                if self.seq_axis is None:
+                    cols = {
+                        k: np.stack([m.example[k] for m in members])
+                        for k in members[0].example
+                    }
+                else:
+                    buckets = {k: s for k, s, _ in sig}
+                    cols = {}
+                    for k in members[0].example:
+                        tgt = buckets[k]
+                        cols[k] = np.stack([
+                            (pad_seq(m.example[k],
+                                     tgt[self.seq_axis], axis=self.seq_axis)
+                             if len(tgt) > self.seq_axis
+                             else m.example[k])
+                            for m in members])
+                    lk = sorted(members[0].example)[0]
+                    cols["_seq_len"] = np.asarray(
+                        [np.shape(m.example[lk])[self.seq_axis]
+                         if len(np.shape(m.example[lk])) > self.seq_axis
+                         else 0 for m in members], np.int32)
                 bucket = bucket_size(len(members), self.max_batch)
                 cols = pad_columns(cols, bucket)
             except Exception as e:  # noqa: BLE001 - bad example payloads

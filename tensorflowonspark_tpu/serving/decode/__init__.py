@@ -1,0 +1,28 @@
+"""Continuous-batching autoregressive decode tier (docs/serving.md,
+"Autoregressive decode").
+
+No reference equivalent — the reference delegates all inference to TF
+Serving (SURVEY.md §2.2); this package gives the framework an
+in-framework LLM decode path on the existing serving runtime:
+
+  - :mod:`~tensorflowonspark_tpu.serving.decode.kvcache` — preallocated
+    slot-paged KV cache, one page per session;
+  - :mod:`~tensorflowonspark_tpu.serving.decode.scheduler` —
+    iteration-level continuous batcher (mid-flight admission, one fused
+    decode step per iteration, immediate slot retirement);
+  - :mod:`~tensorflowonspark_tpu.serving.decode.loadgen` — open-loop
+    Poisson load generator for TTFT / per-token SLOs.
+
+The model half lives in ``models/transformer.py`` (``prefill``,
+``decode_step``, ``greedy_decode_reference``); the frontend half in
+``serving/server.py`` (``Server.generate``, ``POST /v1/generate``).
+"""
+
+from tensorflowonspark_tpu.serving.decode.loadgen import (  # noqa: F401
+    run_open_loop,
+)
+from tensorflowonspark_tpu.serving.decode.scheduler import (  # noqa: F401
+    DecodeEngine,
+    DecodeSpec,
+    PendingSession,
+)

@@ -1,0 +1,83 @@
+"""Slot-paged KV cache for continuous-batching autoregressive decode.
+
+No reference counterpart (the reference delegates all inference to TF
+Serving, SURVEY.md §2.2; reference Inference.scala:27-79 is offline
+batch only).  The layout is vLLM-style slot paging simplified to one
+page per session: two preallocated
+``[slots, n_layers, n_heads, max_seq, head_dim]`` arrays (keys cached
+rope-rotated) plus a per-slot length cursor.  A session owns exactly
+one slot from admission to retirement, so
+
+- admission is O(1): pop a free slot, ``insert`` the prefill K/V;
+- retirement is O(1): push the slot back — no other session's cache
+  moves, no compaction, no shape change (the fused
+  ``models/transformer.decode_step`` always sees the same
+  ``[slots, ...]`` arrays, so it compiles exactly once).
+
+Numerical inertness contract (transformer.decode_step): a free slot
+carries length 0 and is fed token 0, so it attends only position 0 of
+its own page (zeros at init, a stale column after reuse — finite
+either way); its logits row is discarded by the scheduler and no
+operation mixes slots, so free slots cannot perturb occupied ones.
+
+jax is imported lazily: the class is instantiated replica-side only
+(scheduler.DecodeEngine); the driver half of serving never pulls jax.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class SlotKVCache:
+    """Preallocated per-slot K/V pages + host-side cursor/free-list."""
+
+    def __init__(self, cfg, slots, max_seq=None, dtype=None):
+        import jax.numpy as jnp
+
+        self.slots = int(slots)
+        if self.slots < 1:
+            raise ValueError("need at least one slot")
+        self.max_seq = int(max_seq or cfg.max_seq)
+        self.dtype = dtype or cfg.compute_dtype
+        shape = (self.slots, cfg.n_layers, cfg.n_heads, self.max_seq,
+                 cfg.head_dim)
+        self.k = jnp.zeros(shape, self.dtype)
+        self.v = jnp.zeros(shape, self.dtype)
+        # host mirrors: the scheduler reads/writes these every iteration
+        # without a device round-trip
+        self.lengths = np.zeros((self.slots,), np.int32)
+        self._free = list(range(self.slots - 1, -1, -1))  # pop() -> slot 0
+
+    # -- slot lifecycle -----------------------------------------------------
+    def alloc(self):
+        """A free slot index, or None when the cache is full."""
+        return self._free.pop() if self._free else None
+
+    def retire(self, slot):
+        """Return ``slot`` to the free list (cursor back to 0; the page
+        itself is left stale — see the inertness contract above)."""
+        if slot in self._free:
+            raise ValueError(f"slot {slot} is already free")
+        self.lengths[slot] = 0
+        self._free.append(slot)
+
+    def insert(self, slot, k, v, length):
+        """Install a prefill result: ``k``/``v``
+        [n_layers, n_heads, T, head_dim] into ``slot``'s first T
+        columns, cursor to ``length`` (<= T <= max_seq)."""
+        t = k.shape[2]
+        if t > self.max_seq:
+            raise ValueError(f"prefill length {t} > max_seq {self.max_seq}")
+        self.k = self.k.at[slot, :, :, :t, :].set(k.astype(self.dtype))
+        self.v = self.v.at[slot, :, :, :t, :].set(v.astype(self.dtype))
+        self.lengths[slot] = int(length)
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def occupancy(self):
+        return self.slots - len(self._free)
+
+    @property
+    def free_slots(self):
+        return len(self._free)

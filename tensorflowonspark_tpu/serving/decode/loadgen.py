@@ -1,0 +1,117 @@
+"""Open-loop Poisson-arrival load generator for serving SLOs.
+
+No reference counterpart (the reference ships no load tooling; its
+inference surface is the offline batch CLI, Inference.scala:27-79).
+MLPerf-Inference-server-scenario semantics: arrivals are scheduled
+from a seeded exponential inter-arrival process and fired ON SCHEDULE
+regardless of how many requests are still outstanding.  A closed loop
+(N clients, next request only after the last reply — what the serve
+bench lane did before this) self-throttles exactly when the server
+slows down, hiding queueing collapse; an open loop keeps offering the
+configured rate, so p99 latency and shed counts reflect the arrival
+process the SLO is actually written against.
+
+Pure stdlib: usable from bench.py, tests, and examples without jax or
+numpy on the path.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+
+def _pct(sorted_vals, q):
+    """Nearest-rank percentile (server.SLOStats convention)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def run_open_loop(request_fn, *, rate_rps, n_requests, seed=0,
+                  shed_exc=None):
+    """Fire ``n_requests`` calls of ``request_fn(i)`` at Poisson arrivals
+    of ``rate_rps`` and aggregate SLO stats.
+
+    ``request_fn`` runs on a per-arrival thread.  It may return None (a
+    plain request — only wall latency is recorded) or a dict with any of
+    ``ttft_ms`` (float), ``token_ms`` (list of per-token gap floats),
+    ``tokens`` (int count).  Raising ``shed_exc`` counts as a shed;
+    any other exception counts as an error.  Neither stops the run —
+    an open loop keeps offering load.
+
+    Returns one stats dict: request/shed/error counts, offered vs
+    completed rate, latency p50/p99, TTFT p50/p99 and pooled per-token
+    p50/p99 (when any request reported them), and aggregate tokens/s.
+    """
+    rng = random.Random(seed)
+    arrivals, t = [], 0.0
+    for _ in range(int(n_requests)):
+        arrivals.append(t)
+        t += rng.expovariate(float(rate_rps))
+
+    lock = threading.Lock()
+    latency_ms, ttft_ms, token_ms = [], [], []
+    counts = {"completed": 0, "shed": 0, "errors": 0, "tokens": 0}
+
+    def _one(i):
+        t0 = time.perf_counter()
+        try:
+            out = request_fn(i)
+        except Exception as e:  # noqa: BLE001 - classified, never raised
+            key = ("shed" if shed_exc is not None
+                   and isinstance(e, shed_exc) else "errors")
+            with lock:
+                counts[key] += 1
+            return
+        dur = (time.perf_counter() - t0) * 1e3
+        with lock:
+            counts["completed"] += 1
+            latency_ms.append(dur)
+            if isinstance(out, dict):
+                if out.get("ttft_ms") is not None:
+                    ttft_ms.append(float(out["ttft_ms"]))
+                token_ms.extend(float(g) for g in out.get("token_ms") or ())
+                counts["tokens"] += int(out.get("tokens") or 0)
+
+    threads = []
+    start = time.perf_counter()
+    for i, at in enumerate(arrivals):
+        delay = start + at - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        th = threading.Thread(target=_one, args=(i,),
+                              name=f"tfos-loadgen-{i}", daemon=True)
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join()
+    wall = max(time.perf_counter() - start, 1e-9)
+
+    latency_ms.sort()
+    ttft_ms.sort()
+    token_ms.sort()
+    out = {
+        "requests": int(n_requests),
+        "completed": counts["completed"],
+        "shed": counts["shed"],
+        "errors": counts["errors"],
+        "offered_rps": round(rate_rps, 3),
+        "completed_rps": round(counts["completed"] / wall, 3),
+        "duration_s": round(wall, 3),
+        "latency_p50_ms": round(_pct(latency_ms, 0.50), 3),
+        "latency_p99_ms": round(_pct(latency_ms, 0.99), 3),
+    }
+    if ttft_ms:
+        out["ttft_p50_ms"] = round(_pct(ttft_ms, 0.50), 3)
+        out["ttft_p99_ms"] = round(_pct(ttft_ms, 0.99), 3)
+    if token_ms:
+        out["tok_p50_ms"] = round(_pct(token_ms, 0.50), 3)
+        out["tok_p99_ms"] = round(_pct(token_ms, 0.99), 3)
+    if counts["tokens"]:
+        out["tokens"] = counts["tokens"]
+        out["tokens_per_sec"] = round(counts["tokens"] / wall, 2)
+    return out

@@ -1,0 +1,404 @@
+"""Iteration-level continuous batcher for autoregressive decode.
+
+No reference counterpart (the reference delegates all inference to TF
+Serving, SURVEY.md §2.2); this is the Orca-style iteration-level
+scheduler the serving tier mounts behind
+:class:`~tensorflowonspark_tpu.serving.replicas.ReplicaPool`:
+
+- requests admit into free KV-cache slots **mid-flight** — there is no
+  generation-boundary barrier; a new prompt joins the very next engine
+  iteration after a slot frees up;
+- each iteration runs (1) prefill for newly admitted prompts
+  (sequence- and row-bucketed so compile count stays
+  ``O(log slots · log max_seq)``), then (2) ONE fused
+  ``models/transformer.decode_step`` over every occupied slot;
+- a finished sequence (EOS or ``max_tokens``) retires its slot
+  immediately and the slot is eligible for re-admission in the same
+  loop pass.
+
+Tokens stream back through the resolve-once machinery the predict path
+already uses (batcher.PendingResult semantics): the driver-side
+:class:`PendingSession` keys its token ledger by index, so a failover
+replay after a replica SIGKILL (greedy decode is deterministic)
+re-delivers identical ``(index, token)`` pairs — first arrival wins,
+``_set``/``_fail`` resolve once, zero drop and zero dup by
+construction.
+
+Module import stays stdlib + numpy (driver-importable); jax and the
+model only load inside :class:`DecodeEngine`'s replica-side thread.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import threading
+import time
+
+import numpy as np
+
+from tensorflowonspark_tpu.serving import batcher as _batcher
+from tensorflowonspark_tpu.utils import metrics_registry
+
+logger = logging.getLogger(__name__)
+
+SLOTS_ENV = "TFOS_DECODE_SLOTS"
+QUEUE_MAX_ENV = "TFOS_DECODE_QUEUE_MAX"
+MAX_TOKENS_ENV = "TFOS_DECODE_MAX_TOKENS"
+
+
+def slots_default():
+    return int(os.environ.get(SLOTS_ENV, "8"))
+
+
+def queue_max_default():
+    return int(os.environ.get(QUEUE_MAX_ENV, "64"))
+
+
+def max_tokens_default():
+    return int(os.environ.get(MAX_TOKENS_ENV, "64"))
+
+
+class DecodeSpec:
+    """The decode tier's picklable config, carried to replicas inside
+    the ModelSpec payload (replicas.ModelSpec(..., decode=...)).
+
+    ``cfg`` is a ``models/transformer.Config``; ``slots`` sizes the
+    :class:`~.kvcache.SlotKVCache`; ``eos_id``/``max_tokens`` are
+    per-session defaults a request may override (``max_tokens`` is
+    always clamped to the cache page, ``max_seq - len(prompt)``).
+    """
+
+    def __init__(self, cfg, slots=None, eos_id=None, max_tokens=None):
+        self.cfg = cfg
+        self.slots = int(slots or slots_default())
+        self.eos_id = eos_id
+        self.max_tokens = int(max_tokens or max_tokens_default())
+
+
+class PendingSession:
+    """One decode session's future: a streaming token ledger plus the
+    resolve-once result, mirroring ``batcher.PendingResult``.
+
+    The ledger keys on token INDEX: after a replica SIGKILL the session
+    re-prefills on a survivor and greedy decode re-streams the same
+    ``(index, token)`` pairs — the first arrival of an index wins (its
+    timestamp included, so TTFT/per-token stats survive failover), and
+    a duplicate ``gen_done`` is swallowed by the resolve-once gate.
+    """
+
+    __slots__ = ("id", "prompt", "max_tokens", "eos_id", "t_submit",
+                 "_tokens", "_t_arrive", "_event", "_value", "_error",
+                 "_lock")
+
+    def __init__(self, sid, prompt, max_tokens, eos_id):
+        self.id = sid
+        self.prompt = [int(t) for t in prompt]
+        self.max_tokens = int(max_tokens)
+        self.eos_id = eos_id
+        self.t_submit = time.perf_counter()
+        self._tokens = {}           # index -> token (first arrival wins)
+        self._t_arrive = {}         # index -> perf_counter of first arrival
+        self._event = threading.Event()
+        self._value = None
+        self._error = None
+        self._lock = threading.Lock()
+
+    def done(self):
+        return self._event.is_set()
+
+    def tokens_so_far(self):
+        with self._lock:
+            return [self._tokens[i] for i in sorted(self._tokens)]
+
+    def result(self, timeout=None):
+        """Block for the session result dict (``tokens``, ``ttft_ms``,
+        ``token_ms`` gaps, ``total_ms`` + engine meta); raises the
+        session's error or TimeoutError."""
+        timeout = (_batcher.request_timeout_default()
+                   if timeout is None else timeout)
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"decode session not done within {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    # -- resolve-once plumbing (pool._collect calls these) ------------------
+    def _token(self, index, token):
+        with self._lock:
+            if index not in self._tokens:
+                self._tokens[index] = int(token)
+                self._t_arrive[index] = time.perf_counter()
+
+    def _set(self, tokens, meta):
+        with self._lock:
+            if self._event.is_set():
+                return
+            now = time.perf_counter()
+            t0 = self._t_arrive.get(0)
+            gaps = []
+            order = sorted(self._t_arrive)
+            for a, b in zip(order, order[1:]):
+                if b == a + 1:  # only adjacent indices time a real gap
+                    gaps.append((self._t_arrive[b] - self._t_arrive[a]) * 1e3)
+            self._value = {
+                "tokens": [int(t) for t in tokens],
+                "ttft_ms": (round((t0 - self.t_submit) * 1e3, 3)
+                            if t0 is not None else None),
+                "token_ms": [round(g, 3) for g in gaps],
+                "total_ms": round((now - self.t_submit) * 1e3, 3),
+                **(meta or {}),
+            }
+            self._event.set()
+
+    def _fail(self, exc):
+        with self._lock:
+            if not self._event.is_set():
+                self._error = exc
+                self._event.set()
+
+
+class _Slot:
+    """Replica-side per-slot generation state."""
+
+    __slots__ = ("sid", "prompt_len", "generated", "max_tokens", "eos_id",
+                 "last", "t_admit")
+
+    def __init__(self, sid, prompt_len, max_tokens, eos_id, first_token):
+        self.sid = sid
+        self.prompt_len = prompt_len
+        self.max_tokens = max_tokens
+        self.eos_id = eos_id
+        self.generated = [first_token]
+        self.last = first_token
+        self.t_admit = time.perf_counter()
+
+
+class DecodeEngine:
+    """The replica-side continuous-batching loop.
+
+    ``emit(kind, sid, *payload)`` is the wire back to the pool
+    (replicas._make_replica_task routes it onto the manager out-queue):
+    ``("token", sid, index, token)`` per generated token,
+    ``("done", sid, tokens, meta)`` at retirement,
+    ``("error", sid, message)`` on a per-session failure.
+
+    jax, the transformer model and the KV cache are imported/built on
+    the engine thread — constructing a DecodeEngine never touches jax,
+    so driver-side imports stay cheap and axon-hook-safe.
+    """
+
+    def __init__(self, params, spec, emit, replica=0):
+        self._params = params
+        self._spec = spec
+        self._emit = emit
+        self._replica = replica
+        self._q = collections.deque()
+        self._qlock = threading.Lock()
+        self._sids = set()          # sids queued or active (dedupe)
+        self._active = {}           # slot index -> _Slot
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread = None
+        self._started = threading.Event()
+        self._init_error = None
+        self.iterations = 0
+        self.prefills = 0
+        self.retired = 0
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self, timeout=120.0):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="tfos-decode-engine", daemon=True)
+            self._thread.start()
+        if not self._started.wait(timeout):
+            raise TimeoutError("decode engine did not start")
+        if self._init_error is not None:
+            raise self._init_error
+        return self
+
+    def stop(self, timeout=10.0):
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def set_params(self, params):
+        """Hot-reload hook: swap params between iterations.  In-flight
+        sessions finish against their already-cached K/V (old params)
+        plus new-param compute for the remaining tokens — same in-band,
+        no-drop semantics as the predict path's reload."""
+        self._params = params
+
+    def submit(self, sid, prompt, max_tokens=None, eos_id=None):
+        """Queue one session; admission happens at the next iteration.
+        Rejections (prompt too long, duplicate sid) are emitted as
+        session errors, not raised — submit is called from the replica's
+        message loop which must keep draining."""
+        cfg = self._spec.cfg
+        prompt = [int(t) for t in prompt]
+        if not prompt or len(prompt) > cfg.max_seq - 1:
+            self._emit("error", sid,
+                       f"prompt length {len(prompt)} not in [1, "
+                       f"{cfg.max_seq - 1}] (max_seq {cfg.max_seq})")
+            return
+        with self._qlock:
+            if sid in self._sids:
+                return              # failover re-send of a live session
+            self._sids.add(sid)
+            self._q.append({
+                "sid": sid, "prompt": prompt,
+                "max_tokens": int(max_tokens or self._spec.max_tokens),
+                "eos_id": self._spec.eos_id if eos_id is None else eos_id,
+            })
+        self._wake.set()
+
+    def stats(self):
+        with self._qlock:
+            queued = len(self._q)
+        return {
+            "iterations": self.iterations,
+            "prefills": self.prefills,
+            "retired": self.retired,
+            "active": len(self._active),
+            "queued": queued,
+            "slots": self._spec.slots,
+        }
+
+    # -- engine thread ------------------------------------------------------
+    def _run(self):
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            from tensorflowonspark_tpu.models import transformer
+            from tensorflowonspark_tpu.serving.decode import kvcache
+
+            cfg = self._spec.cfg
+
+            def _prefill(p, toks, lens):
+                logits, k, v = transformer.prefill(p, toks, cfg,
+                                                   lengths=lens)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), k, v
+
+            def _step(p, toks, ck, cv, lens):
+                logits, ck, cv = transformer.decode_step(
+                    p, toks, cfg, ck, cv, lens)
+                return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
+                        ck, cv)
+
+            self._prefill_jit = jax.jit(_prefill)
+            self._step_jit = jax.jit(_step)
+            self._kvcache_mod = kvcache
+            cache = kvcache.SlotKVCache(cfg, self._spec.slots)
+        except BaseException as e:  # noqa: BLE001 - surface via start()
+            self._init_error = e
+            self._started.set()
+            return
+        self._started.set()
+        while not self._stop.is_set():
+            try:
+                self._admit(cache)
+                if not self._active:
+                    self._wake.wait(0.02)
+                    self._wake.clear()
+                    continue
+                self._iterate(cache)
+            except BaseException as e:  # noqa: BLE001 - fail the cohort,
+                # rebuild the cache, keep the replica serving
+                logger.exception("decode engine iteration failed")
+                self._fail_all(repr(e))
+                cache = self._kvcache_mod.SlotKVCache(
+                    self._spec.cfg, self._spec.slots)
+
+    def _admit(self, cache):
+        """Move queued sessions into free slots: bucketed prefill, then
+        first-token emission (the prefill logits ARE token 0)."""
+        batch = []
+        with self._qlock:
+            while self._q and len(batch) < cache.free_slots:
+                batch.append(self._q.popleft())
+        if not batch:
+            return
+        cfg = self._spec.cfg
+        # group by sequence bucket so compile count stays logarithmic
+        groups = {}
+        for req in batch:
+            t = _batcher.bucket_seq(len(req["prompt"]), cfg.max_seq)
+            groups.setdefault(t, []).append(req)
+        for t, members in groups.items():
+            rows = _batcher.bucket_size(len(members), self._spec.slots)
+            toks = np.stack([
+                _batcher.pad_seq(np.asarray(m["prompt"], np.int32), t)
+                for m in members])
+            lens = np.asarray([len(m["prompt"]) for m in members], np.int32)
+            toks = _batcher.pad_rows(toks, rows)
+            lens = _batcher.pad_rows(lens, rows)
+            firsts, k, v = self._prefill_jit(self._params, toks, lens)
+            firsts = np.asarray(firsts)
+            self.prefills += 1
+            for i, req in enumerate(members):
+                slot = cache.alloc()
+                # cannot be None: admission is bounded by free_slots
+                cache.insert(slot, k[i], v[i], len(req["prompt"]))
+                first = int(firsts[i])
+                mt = min(req["max_tokens"],
+                         cache.max_seq - len(req["prompt"]))
+                st = _Slot(req["sid"], len(req["prompt"]), max(1, mt),
+                           req["eos_id"], first)
+                self._active[slot] = st
+                self._emit("token", st.sid, 0, first)
+                if (st.eos_id is not None and first == st.eos_id) \
+                        or st.max_tokens <= 1:
+                    self._retire(cache, slot)
+        metrics_registry.set_gauge("tfos_decode_slot_occupancy",
+                                   cache.occupancy)
+
+    def _iterate(self, cache):
+        """One fused decode step over every occupied slot."""
+        tokens = np.zeros((cache.slots,), np.int32)
+        for slot, st in self._active.items():
+            tokens[slot] = st.last
+        nxt, cache.k, cache.v = self._step_jit(
+            self._params, tokens, cache.k, cache.v, cache.lengths)
+        nxt = np.asarray(nxt)
+        self.iterations += 1
+        for slot in list(self._active):
+            st = self._active[slot]
+            cache.lengths[slot] += 1
+            tok = int(nxt[slot])
+            st.generated.append(tok)
+            st.last = tok
+            self._emit("token", st.sid, len(st.generated) - 1, tok)
+            if (st.eos_id is not None and tok == st.eos_id) \
+                    or len(st.generated) >= st.max_tokens \
+                    or cache.lengths[slot] >= cache.max_seq:
+                self._retire(cache, slot)
+        metrics_registry.set_gauge("tfos_decode_slot_occupancy",
+                                   cache.occupancy)
+
+    def _retire(self, cache, slot):
+        st = self._active.pop(slot)
+        cache.retire(slot)
+        with self._qlock:
+            self._sids.discard(st.sid)
+        self.retired += 1
+        metrics_registry.inc("tfos_decode_retired_total")
+        self._emit("done", st.sid, list(st.generated), {
+            "replica": self._replica,
+            "prompt_len": st.prompt_len,
+            "gen_ms": round((time.perf_counter() - st.t_admit) * 1e3, 3),
+        })
+
+    def _fail_all(self, message):
+        with self._qlock:
+            queued = list(self._q)
+            self._q.clear()
+            self._sids.clear()
+        for req in queued:
+            self._emit("error", req["sid"], message)
+        for st in self._active.values():
+            self._emit("error", st.sid, message)
+        self._active.clear()

@@ -86,19 +86,27 @@ class ModelSpec:
     ``jit``: True forces AOT compilation (error if the predict is not
     jax-pure), False forces eager, None ("auto") tries AOT and falls
     back to eager.
+
+    ``decode``: a ``serving.decode.scheduler.DecodeSpec`` mounts the
+    continuous-batching autoregressive decode engine on every replica
+    (docs/serving.md "Autoregressive decode"); the pool then accepts
+    ``dispatch_session`` alongside batch ``dispatch``.  A decode-only
+    spec needs no ``predict`` — params still resolve from
+    ``export_dir``/``params``/``ckpt_dir``.
     """
 
     def __init__(self, export_dir=None, ckpt_dir=None, predict=None,
-                 params=None, jit=None):
-        if export_dir is None and predict is None:
+                 params=None, jit=None, decode=None):
+        if export_dir is None and predict is None and decode is None:
             raise ValueError(
-                "ModelSpec needs an export_dir or a predict "
-                "callable/'module:qualname' string")
+                "ModelSpec needs an export_dir, a predict "
+                "callable/'module:qualname' string, or a decode spec")
         self.export_dir = export_dir
         self.ckpt_dir = ckpt_dir
         self.predict = predict
         self.params = params
         self.jit = jit
+        self.decode = decode
 
     def to_payload(self):
         return {
@@ -107,6 +115,7 @@ class ModelSpec:
             "predict": self.predict,
             "params": self.params,
             "jit": self.jit,
+            "decode": self.decode,
         }
 
 
@@ -152,6 +161,10 @@ class _Predictor:
             return None
 
     def __call__(self, inputs):
+        if self._fn is None:
+            raise RuntimeError(
+                "this spec serves decode sessions only (no predict "
+                "signature); use generate, not predict")
         sig = self._sig(inputs)
         if sig not in self._compiled:
             self._compiled[sig] = self._lower(inputs)
@@ -212,11 +225,11 @@ def _resolve_predictor(payload):
         params, meta = ckpt.load_exported(payload["export_dir"])
         if not callable(fn):
             spec = (fn if isinstance(fn, str) else None) or meta.get("predict")
-            if not spec:
+            if not spec and payload.get("decode") is None:
                 raise ValueError(
                     f"export {payload['export_dir']} has no 'predict' "
                     "metadata and the spec names no callable")
-            fn = _import_qualname(spec)
+            fn = _import_qualname(spec) if spec else None
     elif isinstance(fn, str):
         fn = _import_qualname(fn)
     pred = _Predictor(fn, params, version, payload.get("jit"))
@@ -259,7 +272,20 @@ def _make_replica_task(payload_blob, mgr_addr, mgr_authkey):
         outq = mgr.get_queue(OUT_QUEUE)
         telemetry.configure(node_id=f"replica-{idx}", role="serving")
         try:
-            pred = _resolve_predictor(cloudpickle.loads(payload_blob))
+            payload = cloudpickle.loads(payload_blob)
+            pred = _resolve_predictor(payload)
+            engine = None
+            if payload.get("decode") is not None:
+                from tensorflowonspark_tpu.serving.decode.scheduler import (
+                    DecodeEngine,
+                )
+
+                def _gen_emit(kind, sid, *rest):
+                    outq.put(("gen_" + kind, idx, sid) + tuple(rest))
+
+                engine = DecodeEngine(
+                    pred.params, payload["decode"], _gen_emit,
+                    replica=idx).start()
         except BaseException as e:  # noqa: BLE001 - report, then fail task
             outq.put(("init_error", idx, repr(e)))
             raise
@@ -289,16 +315,33 @@ def _make_replica_task(payload_blob, mgr_addr, mgr_authkey):
                     break
                 if kind == "reload":
                     try:
-                        ckpt_dir = cloudpickle.loads(payload_blob).get(
-                            "ckpt_dir")
-                        if ckpt_dir:
-                            _maybe_reload(pred, ckpt_dir)
+                        if payload.get("ckpt_dir"):
+                            if _maybe_reload(pred, payload["ckpt_dir"]) \
+                                    and engine is not None:
+                                engine.set_params(pred.params)
                         outq.put(("reloaded", idx, pred.version))
                     except Exception as e:  # noqa: BLE001 - keep serving
                         logger.exception("reload failed")
                         outq.put(("reload_error", idx, repr(e)))
                 elif kind == "stats":
-                    outq.put(("stats", idx, pred.stats()))
+                    st = pred.stats()
+                    if engine is not None:
+                        st["decode"] = engine.stats()
+                    outq.put(("stats", idx, st))
+                elif kind == "gen":
+                    _, sid, blob = msg
+                    if engine is None:
+                        outq.put(("gen_error", idx, sid,
+                                  "spec has no decode engine"))
+                        continue
+                    try:
+                        req = cloudpickle.loads(blob)
+                        engine.submit(sid, req["prompt"],
+                                      max_tokens=req.get("max_tokens"),
+                                      eos_id=req.get("eos_id"))
+                    except BaseException as e:  # noqa: BLE001 - one bad
+                        # session must not take the replica down
+                        outq.put(("gen_error", idx, sid, repr(e)))
                 elif kind == "batch":
                     _, batch_id, blob = msg
                     try:
@@ -319,6 +362,8 @@ def _make_replica_task(payload_blob, mgr_addr, mgr_authkey):
                                   f"{e!r}\n{traceback.format_exc()}"))
         finally:
             stop_beat.set()
+            if engine is not None:
+                engine.stop()
             outq.put(("down", idx))
             telemetry.flush()
 
@@ -346,6 +391,7 @@ class ReplicaPool:
         self._pids = {}              # idx -> os pid (latest incarnation)
         self._versions = {}          # idx -> last acked params version
         self._inflight = {}          # batch_id -> entry dict
+        self._sessions = {}          # session id -> decode session entry
         self._loads = {}             # idx -> in-flight batch count
         self._stats_replies = {}
         self._stats_event = threading.Event()
@@ -415,8 +461,12 @@ class ReplicaPool:
         with self._lock:
             entries = list(self._inflight.values())
             self._inflight.clear()
+            sessions = list(self._sessions.values())
+            self._sessions.clear()
         for entry in entries:
             entry["batch"].fail(err)
+        for entry in sessions:
+            entry["session"]._fail(err)
         for inq in self._inqs.values():
             try:
                 inq.put(("stop",))
@@ -449,6 +499,47 @@ class ReplicaPool:
             }
             self._loads[idx] = self._loads.get(idx, 0) + 1
         self._inqs[idx].put(("batch", batch.id, blob))
+
+    def dispatch_session(self, session):
+        """Send one decode :class:`~.decode.scheduler.PendingSession` to
+        the least-loaded live replica.  Same failover contract as batch
+        dispatch: a dead replica's sessions re-dispatch to survivors
+        (full re-prefill there), and the session's index-keyed ledger
+        plus resolve-once ``_set`` make the replay zero-drop/zero-dup.
+        """
+        if self.spec.decode is None:
+            raise RuntimeError("spec has no decode engine; pass "
+                               "ModelSpec(..., decode=DecodeSpec(...))")
+        if self._job_error is not None and not self._live:
+            raise RuntimeError(
+                f"no replicas left (job failed: {self._job_error})")
+        blob = cloudpickle.dumps({
+            "prompt": session.prompt,
+            "max_tokens": session.max_tokens,
+            "eos_id": session.eos_id,
+        })
+        with self._lock:
+            idx = self._pick_replica_locked()
+            self._sessions[session.id] = {
+                "session": session, "blob": blob, "replica": idx,
+                "t": time.monotonic(),
+            }
+            self._loads[idx] = self._loads.get(idx, 0) + 1
+        self._inqs[idx].put(("gen", session.id, blob))
+
+    def cancel_session(self, sid):
+        """Forget a session (client gave up): its slot keeps generating
+        replica-side, but late answers find no entry and are dropped."""
+        with self._lock:
+            entry = self._sessions.pop(sid, None)
+            if entry is not None:
+                i = entry["replica"]
+                self._loads[i] = max(0, self._loads.get(i, 1) - 1)
+        return entry is not None
+
+    def outstanding_sessions(self):
+        with self._lock:
+            return len(self._sessions)
 
     def _pick_replica_locked(self):
         candidates = sorted(self._live) or list(range(self.num_replicas))
@@ -516,6 +607,34 @@ class ReplicaPool:
                 if entry is not None:
                     entry["batch"].fail(RuntimeError(
                         f"replica {idx} failed the batch:\n{tb}"))
+            elif kind == "gen_token":
+                _, idx, sid, tindex, tok = msg
+                with self._lock:
+                    entry = self._sessions.get(sid)
+                    if entry is not None:
+                        entry["t"] = time.monotonic()  # streaming = alive
+                if entry is not None:
+                    entry["session"]._token(tindex, tok)
+            elif kind == "gen_done":
+                _, idx, sid, tokens, meta = msg
+                with self._lock:
+                    entry = self._sessions.pop(sid, None)
+                    if entry is not None:
+                        i = entry["replica"]
+                        self._loads[i] = max(0, self._loads.get(i, 1) - 1)
+                if entry is None:
+                    continue  # duplicate answer after a re-dispatch
+                entry["session"]._set(tokens, meta)
+            elif kind == "gen_error":
+                _, idx, sid, err = msg
+                with self._lock:
+                    entry = self._sessions.pop(sid, None)
+                    if entry is not None:
+                        i = entry["replica"]
+                        self._loads[i] = max(0, self._loads.get(i, 1) - 1)
+                if entry is not None:
+                    entry["session"]._fail(RuntimeError(
+                        f"replica {idx} failed the decode session: {err}"))
             elif kind == "reloaded":
                 with self._lock:
                     self._versions[msg[1]] = msg[2]
@@ -567,6 +686,18 @@ class ReplicaPool:
                     entry["batch"].fail(TimeoutError(
                         "batch not answered within "
                         f"{self._request_timeout}s"))
+                # decode sessions: ``t`` refreshes on every streamed
+                # token (collect), so only a genuinely stalled stream
+                # times out — not a long, healthy generation
+                stale_s = []
+                with self._lock:
+                    for sid, entry in list(self._sessions.items()):
+                        if now - entry["t"] > self._request_timeout:
+                            stale_s.append(self._sessions.pop(sid))
+                for entry in stale_s:
+                    entry["session"]._fail(TimeoutError(
+                        "decode session streamed no token within "
+                        f"{self._request_timeout}s"))
 
     def _redispatch(self, dead_idxs):
         with self._lock:
@@ -586,9 +717,26 @@ class ReplicaPool:
                 self._loads[idx] = self._loads.get(idx, 0) + 1
             self._inqs[idx].put(
                 ("batch", entry["batch"].id, entry["blob"]))
-        if orphans and target_pool:
+        # decode sessions of the dead replica: re-send for a full
+        # re-prefill on a survivor.  Greedy decode is deterministic, so
+        # the survivor re-streams identical (index, token) pairs — the
+        # session ledger keeps first arrivals and _set resolves once.
+        with self._lock:
+            orphan_sessions = [e for e in self._sessions.values()
+                               if e["replica"] in dead_idxs]
+        for entry in orphan_sessions:
+            with self._lock:
+                if not self._live:
+                    break  # respawned replica inherits its inbox
+                idx = self._pick_replica_locked()
+                entry["replica"] = idx
+                entry["t"] = time.monotonic()
+                self._loads[idx] = self._loads.get(idx, 0) + 1
+            self._inqs[idx].put(
+                ("gen", entry["session"].id, entry["blob"]))
+        if (orphans or orphan_sessions) and target_pool:
             telemetry.event("serve/redispatch", batches=len(orphans),
-                            to=target_pool)
+                            sessions=len(orphan_sessions), to=target_pool)
 
     def _proc_alive(self, idx):
         procs = getattr(self._engine, "_procs", None)

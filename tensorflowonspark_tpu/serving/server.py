@@ -24,6 +24,7 @@ silently dropped — a client always gets an answer or an explicit error.
 from __future__ import annotations
 
 import argparse
+import itertools
 import json
 import logging
 import threading
@@ -33,6 +34,7 @@ import numpy as np
 
 from tensorflowonspark_tpu.serving import batcher as _batcher
 from tensorflowonspark_tpu.serving.batcher import MicroBatcher, Overloaded
+from tensorflowonspark_tpu.serving.decode import scheduler as _decode
 from tensorflowonspark_tpu.serving.replicas import ModelSpec, ReplicaPool
 from tensorflowonspark_tpu.utils import metrics_registry, telemetry
 
@@ -115,6 +117,63 @@ class SLOStats:
         }
 
 
+class DecodeStats:
+    """Thread-safe decode-session counters + TTFT / per-token
+    percentiles (docs/serving.md "Autoregressive decode").
+
+    TTFT (time to first token) and per-token gap are the two decode
+    SLOs; total-latency percentiles alone hide a slow-start server
+    behind a fast steady state and vice versa.
+    """
+
+    def __init__(self, sample_cap=100_000):
+        self._lock = threading.Lock()
+        self._cap = sample_cap
+        self.ttft_ms = []
+        self.token_ms = []
+        self.completed = 0
+        self.shed = 0
+        self.errors = 0
+        self.tokens = 0
+
+    def observe_session(self, result):
+        with self._lock:
+            self.completed += 1
+            self.tokens += len(result.get("tokens") or ())
+            if result.get("ttft_ms") is not None \
+                    and len(self.ttft_ms) < self._cap:
+                self.ttft_ms.append(result["ttft_ms"])
+            if len(self.token_ms) < self._cap:
+                self.token_ms.extend(result.get("token_ms") or ())
+
+    def observe_shed(self):
+        with self._lock:
+            self.shed += 1
+
+    def observe_error(self):
+        with self._lock:
+            self.errors += 1
+
+    def summary(self):
+        with self._lock:
+            ttft = sorted(self.ttft_ms)
+            gaps = sorted(self.token_ms)
+            completed, shed, errors = self.completed, self.shed, self.errors
+            tokens = self.tokens
+        seen = completed + shed + errors
+        return {
+            "sessions": seen,
+            "completed": completed,
+            "shed": shed,
+            "errors": errors,
+            "tokens": tokens,
+            "ttft_p50_ms": round(_pct(ttft, 0.50), 3),
+            "ttft_p99_ms": round(_pct(ttft, 0.99), 3),
+            "tok_p50_ms": round(_pct(gaps, 0.50), 3),
+            "tok_p99_ms": round(_pct(gaps, 0.99), 3),
+        }
+
+
 class Server:
     """An online model service over the cluster runtime.
 
@@ -133,11 +192,15 @@ class Server:
 
     def __init__(self, spec, num_replicas=None, max_batch=None,
                  max_delay_ms=None, queue_max=None, engine=None, env=None,
-                 request_timeout=None):
+                 request_timeout=None, decode_queue_max=None,
+                 seq_axis=None, seq_cap=None):
         self.spec = spec
         self.stats = SLOStats()
+        self.decode_stats = DecodeStats()
         self.request_timeout = (request_timeout
                                 or _batcher.request_timeout_default())
+        self.decode_queue_max = (decode_queue_max
+                                 or _decode.queue_max_default())
         self.pool = ReplicaPool(
             spec, num_replicas=num_replicas, engine=engine, env=env,
             request_timeout=self.request_timeout)
@@ -145,7 +208,8 @@ class Server:
             self.pool.dispatch, max_batch=max_batch,
             max_delay_ms=max_delay_ms, queue_max=queue_max,
             observer=self._on_request, batch_observer=self._on_batch,
-            on_shed=self._on_shed)
+            on_shed=self._on_shed, seq_axis=seq_axis, seq_cap=seq_cap)
+        self._session_ids = itertools.count(1)
         self._stopped = False
 
     # -- observers (batcher -> stats + telemetry + live metrics) ------------
@@ -206,6 +270,59 @@ class Server:
             metrics_registry.inc("tfos_serve_requests_total", status="error")
             raise
 
+    def generate(self, prompt, max_tokens=None, eos_id=None, timeout=None):
+        """One autoregressive decode session: ``prompt`` is a list of
+        int token ids; returns ``{"tokens": [...], "ttft_ms", "token_ms"
+        (per-token gaps), "total_ms", ...engine meta}``.
+
+        Admission control mirrors ``predict``: past
+        ``TFOS_DECODE_QUEUE_MAX`` outstanding sessions, raises
+        :class:`~.batcher.Overloaded` (HTTP maps it to 503 +
+        Retry-After).  The session survives replica SIGKILL — the pool
+        re-prefills it on a survivor, and the resolve-once ledger
+        guarantees zero dropped / zero duplicated tokens.
+        """
+        if self.spec.decode is None:
+            raise RuntimeError("spec has no decode engine; pass "
+                               "ModelSpec(..., decode=DecodeSpec(...))")
+        depth = self.pool.outstanding_sessions()
+        if depth >= self.decode_queue_max:
+            self.decode_stats.observe_shed()
+            metrics_registry.inc("tfos_decode_sessions_total", status="shed")
+            telemetry.event(telemetry.DECODE_SHED, depth=depth,
+                            limit=self.decode_queue_max)
+            raise Overloaded(depth, self.decode_queue_max)
+        session = _decode.PendingSession(
+            next(self._session_ids), prompt,
+            max_tokens or (self.spec.decode.max_tokens
+                           if self.spec.decode else None)
+            or _decode.max_tokens_default(),
+            self.spec.decode.eos_id if eos_id is None else eos_id)
+        self.pool.dispatch_session(session)
+        try:
+            out = session.result(timeout or self.request_timeout)
+        except Overloaded:
+            raise
+        except Exception:
+            self.pool.cancel_session(session.id)
+            self.decode_stats.observe_error()
+            metrics_registry.inc("tfos_decode_sessions_total",
+                                 status="error")
+            raise
+        self.decode_stats.observe_session(out)
+        metrics_registry.inc("tfos_decode_sessions_total", status="ok")
+        metrics_registry.inc("tfos_decode_tokens_total",
+                             len(out.get("tokens") or ()))
+        if out.get("ttft_ms") is not None:
+            metrics_registry.observe("tfos_decode_ttft_ms", out["ttft_ms"])
+        for gap in out.get("token_ms") or ():
+            metrics_registry.observe("tfos_decode_token_ms", gap)
+        telemetry.record_span(
+            telemetry.DECODE_SESSION, out["total_ms"] / 1e3,
+            tokens=len(out.get("tokens") or ()),
+            ttft_ms=out.get("ttft_ms"), replica=out.get("replica"))
+        return out
+
     def client(self):
         return Client(self)
 
@@ -215,6 +332,8 @@ class Server:
         out = self.stats.summary()
         out["replicas"] = self.pool.live_replicas()
         out["versions"] = self.pool.versions()
+        if self.spec.decode is not None:
+            out["decode"] = self.decode_stats.summary()
         if include_replicas:
             out["replica_stats"] = self.pool.stats()
         return out
@@ -228,6 +347,10 @@ class Client:
 
     def predict(self, example, timeout=None):
         return self._server.predict(example, timeout=timeout)
+
+    def generate(self, prompt, max_tokens=None, eos_id=None, timeout=None):
+        return self._server.generate(prompt, max_tokens=max_tokens,
+                                     eos_id=eos_id, timeout=timeout)
 
 
 # ---------------------------------------------------------------------------
@@ -264,6 +387,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         srv = self.server.tfos_server
+        if self.path == "/v1/generate":
+            self._do_generate(srv)
+            return
         if self.path != "/v1/predict":
             self._reply(404, {"error": f"no route {self.path}"})
             return
@@ -294,6 +420,37 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(200, {
             "outputs": {k: np.asarray(v).tolist() for k, v in row.items()}
         })
+
+    def _do_generate(self, srv):
+        """POST /v1/generate: ``{"prompt": [ids], "max_tokens"?,
+        "eos_id"?}`` -> the session result dict (docs/serving.md)."""
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            prompt = payload.get("prompt")
+            if not isinstance(prompt, list) or not prompt:
+                raise ValueError(
+                    'body must be {"prompt": [token ids], ...}')
+            prompt = [int(t) for t in prompt]
+        except (ValueError, TypeError) as e:
+            self._reply(400, {"error": str(e)})
+            return
+        try:
+            out = srv.generate(prompt,
+                               max_tokens=payload.get("max_tokens"),
+                               eos_id=payload.get("eos_id"))
+        except Overloaded as e:
+            self._reply(503, {"error": "overloaded",
+                              "retry_after": round(e.retry_after, 3)},
+                        headers={"Retry-After": f"{e.retry_after:.3f}"})
+            return
+        except TimeoutError as e:
+            self._reply(504, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 - surface, don't crash
+            self._reply(500, {"error": repr(e)})
+            return
+        self._reply(200, out)
 
 
 def serve_http(server, host="127.0.0.1", port=8500, block=True):

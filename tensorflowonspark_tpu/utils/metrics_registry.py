@@ -116,6 +116,21 @@ CATALOG = {
         "counter", "Real (non-padding) rows in dispatched batches."),
     "tfos_serve_reloads_total": (
         "counter", "Checkpoint hot-reload broadcasts."),
+    # decode (serving/decode/ — server process + replica engines)
+    "tfos_decode_sessions_total": (
+        "counter", "Decode sessions, by status (ok|error|shed)."),
+    "tfos_decode_tokens_total": (
+        "counter", "Tokens generated by completed decode sessions."),
+    "tfos_decode_ttft_ms": (
+        "histogram", "Decode time-to-first-token, milliseconds."),
+    "tfos_decode_token_ms": (
+        "histogram", "Decode per-token gap (inter-token latency), "
+                     "milliseconds."),
+    "tfos_decode_slot_occupancy": (
+        "gauge", "KV-cache slots occupied after the last engine "
+                 "iteration."),
+    "tfos_decode_retired_total": (
+        "counter", "Decode sessions retired (EOS or max_tokens)."),
     # checkpoint (any process)
     "tfos_checkpoint_saves_total": (
         "counter", "Checkpoint saves completed."),

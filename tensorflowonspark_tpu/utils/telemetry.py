@@ -75,6 +75,8 @@ SERVE_REQUEST = "serve/request"
 SERVE_SHED = "serve/shed"
 SERVE_BATCH = "serve/replica_batch"   # replica-side device batch span
 SERVE_RELOAD = "serve/reload"         # hot-reload broadcast event
+DECODE_SESSION = "decode/session"     # one autoregressive decode session
+DECODE_SHED = "decode/shed"           # decode admission-control rejection
 
 
 class Recorder:
