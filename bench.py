@@ -828,6 +828,7 @@ def main():
                      ("segmentation", _segmentation_bench),
                      ("batch_inference", _inference_bench),
                      ("serve", _serve_bench),
+                     ("decode", _decode_bench),
                      ("data", _data_bench),
                      ("elastic", _elastic_bench)):
         if os.environ.get(f"TFOS_BENCH_{name.upper()}", "1") != "0":
@@ -1135,9 +1136,17 @@ def _inference_bench(dev, on_tpu):
 
 def _serve_bench(dev, on_tpu):
     """Online-serving lane (TFOS_BENCH_SERVE=0 to skip): a 2-replica
-    CPU service under concurrent in-process clients — latency
-    percentiles, req/s, shed rate, micro-batch coalescing and the
-    per-bucket compile counts (docs/serving.md).
+    CPU service under OPEN-LOOP Poisson load — latency p50/p99, req/s,
+    shed rate, micro-batch coalescing and the per-bucket compile counts
+    (docs/serving.md).  Open loop (serving/decode/loadgen.py) replaced
+    the old closed-loop client burst: a closed loop self-throttles when
+    the server slows, hiding queueing collapse; arrivals now fire on a
+    seeded schedule regardless of outstanding requests, so the p99 is
+    the one the SLO is written against.  TFOS_BENCH_SERVE_RPS sets the
+    offered rate, TFOS_BENCH_SERVE_N the request count; the legacy
+    TFOS_BENCH_SERVE_CLIENTS x TFOS_BENCH_SERVE_REQUESTS pair survives
+    as a deprecated alias for the total when TFOS_BENCH_SERVE_N is
+    unset.
 
     Replicas are FORCED onto CPU regardless of the bench device: the
     tunnel serializes TPU claims, and the main bench process holds the
@@ -1145,17 +1154,21 @@ def _serve_bench(dev, on_tpu):
     """
     import shutil
     import tempfile
-    import threading
 
     import jax
 
     from tensorflowonspark_tpu import serving
     from tensorflowonspark_tpu.models import mnist
+    from tensorflowonspark_tpu.serving.decode import run_open_loop
     from tensorflowonspark_tpu.utils import checkpoint as ckpt
 
     replicas = int(os.environ.get("TFOS_BENCH_SERVE_REPLICAS", "2"))
+    # deprecated alias: CLIENTS x REQUESTS was the closed-loop total
     clients = int(os.environ.get("TFOS_BENCH_SERVE_CLIENTS", "64"))
     per_client = int(os.environ.get("TFOS_BENCH_SERVE_REQUESTS", "6"))
+    n_requests = int(os.environ.get("TFOS_BENCH_SERVE_N",
+                                    str(clients * per_client)))
+    rate_rps = float(os.environ.get("TFOS_BENCH_SERVE_RPS", "120"))
     tmp = tempfile.mkdtemp(prefix="tfos_bench_serve_")
     try:
         params = mnist.init_params(jax.random.PRNGKey(0))
@@ -1165,8 +1178,7 @@ def _serve_bench(dev, on_tpu):
         })
         spec = serving.ModelSpec(export_dir=export)
         rng = np.random.default_rng(0)
-        images = rng.random((clients, 28, 28, 1), np.float32)
-        errors = [0]
+        images = rng.random((64, 28, 28, 1), np.float32)
 
         with serving.Server(
             spec, num_replicas=replicas, max_batch=32, max_delay_ms=5,
@@ -1177,35 +1189,25 @@ def _serve_bench(dev, on_tpu):
             for _ in range(2):
                 client.predict({"image": images[0]}, timeout=120)
 
-            def burst(i):
-                for _ in range(per_client):
-                    try:
-                        client.predict({"image": images[i]}, timeout=120)
-                    except Exception:  # noqa: BLE001 - counted, not fatal
-                        errors[0] += 1
-
-            threads = [threading.Thread(target=burst, args=(i,))
-                       for i in range(clients)]
-            t0 = time.perf_counter()
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
-            dt = time.perf_counter() - t0
+            stats = run_open_loop(
+                lambda i: client.predict(
+                    {"image": images[i % len(images)]}, timeout=120),
+                rate_rps=rate_rps, n_requests=n_requests, seed=0,
+                shed_exc=serving.Overloaded)
             summ = srv.summary(include_replicas=True)
 
-        total = clients * per_client
         out = {
-            "requests": total,
-            "req_per_sec": round(total / dt, 1),
-            "p50_ms": summ.get("p50_ms"),
-            "p95_ms": summ.get("p95_ms"),
-            "p99_ms": summ.get("p99_ms"),
+            "requests": stats["requests"],
+            "req_per_sec": stats["completed_rps"],
+            "offered_rps": stats["offered_rps"],
+            "p50_ms": stats["latency_p50_ms"],
+            "p99_ms": stats["latency_p99_ms"],
+            "shed": stats["shed"],
             "shed_rate": summ.get("shed_rate"),
             "mean_device_batch": summ.get("mean_device_batch"),
             "buckets": summ.get("buckets"),
             "replicas": replicas,
-            "client_errors": errors[0],
+            "client_errors": stats["errors"],
         }
         compiles = {}
         for st in (summ.get("replica_stats") or {}).values():
@@ -1214,6 +1216,85 @@ def _serve_bench(dev, on_tpu):
         if compiles:
             out["compiles"] = compiles
         return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _decode_bench(dev, on_tpu):
+    """Autoregressive-decode lane (TFOS_BENCH_DECODE=0 to skip): a
+    2-replica continuous-batching decode service under open-loop
+    Poisson session arrivals — TTFT p50/p99, per-token-gap p50/p99 and
+    aggregate tokens/s, the three SLO numbers docs/serving.md defines
+    for the decode tier.
+
+    Like the serve lane, replicas are FORCED onto CPU: the main bench
+    process may hold the (serialized) TPU claim.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+
+    from tensorflowonspark_tpu import serving
+    from tensorflowonspark_tpu.models import transformer as _tfm
+    from tensorflowonspark_tpu.serving.decode import run_open_loop
+    from tensorflowonspark_tpu.utils import checkpoint as ckpt
+
+    replicas = int(os.environ.get("TFOS_BENCH_DECODE_REPLICAS", "2"))
+    slots = int(os.environ.get("TFOS_BENCH_DECODE_SLOTS", "8"))
+    n_sessions = int(os.environ.get("TFOS_BENCH_DECODE_N", "24"))
+    rate_rps = float(os.environ.get("TFOS_BENCH_DECODE_RPS", "4"))
+    max_tokens = int(os.environ.get("TFOS_BENCH_DECODE_TOKENS", "16"))
+    cfg = _tfm.Config(vocab_size=512, dim=128, n_layers=2, n_heads=4,
+                      max_seq=128, dtype="float32", attn_impl="reference")
+    tmp = tempfile.mkdtemp(prefix="tfos_bench_decode_")
+    try:
+        params = _tfm.init(jax.random.PRNGKey(0), cfg)
+        export = os.path.join(tmp, "export")
+        ckpt.export_model(export, params, metadata={})
+        spec = serving.ModelSpec(
+            export_dir=export,
+            decode=serving.DecodeSpec(cfg, slots=slots,
+                                      max_tokens=max_tokens))
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, cfg.vocab_size, size=n).tolist()
+                   for n in rng.integers(4, 33, size=n_sessions)]
+
+        with serving.Server(
+            spec, num_replicas=replicas, request_timeout=300,
+            env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""},
+        ) as srv:
+            # warmup: pay jax import + prefill/decode_step compiles on
+            # every replica before the clock starts
+            for _ in range(replicas):
+                srv.generate(prompts[0], max_tokens=2, timeout=300)
+
+            def session(i):
+                out = srv.generate(prompts[i % len(prompts)],
+                                   max_tokens=max_tokens, timeout=300)
+                return {"ttft_ms": out.get("ttft_ms"),
+                        "token_ms": out.get("token_ms"),
+                        "tokens": len(out.get("tokens") or ())}
+
+            stats = run_open_loop(session, rate_rps=rate_rps,
+                                  n_requests=n_sessions, seed=0,
+                                  shed_exc=serving.Overloaded)
+
+        return {
+            "sessions": stats["requests"],
+            "completed": stats["completed"],
+            "shed": stats["shed"],
+            "errors": stats["errors"],
+            "offered_rps": stats["offered_rps"],
+            "tokens": stats.get("tokens", 0),
+            "tokens_per_sec": stats.get("tokens_per_sec", 0.0),
+            "ttft_p50_ms": stats.get("ttft_p50_ms"),
+            "ttft_p99_ms": stats.get("ttft_p99_ms"),
+            "tok_p50_ms": stats.get("tok_p50_ms"),
+            "tok_p99_ms": stats.get("tok_p99_ms"),
+            "replicas": replicas,
+            "slots": slots,
+        }
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 

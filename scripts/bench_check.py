@@ -3,7 +3,8 @@
 
 Diffs the newest usable bench line against the prior round, lane by
 lane (ResNet img/s, transformer tok/s, fed img/s, data rec/s, serve
-p99, ...), and exits non-zero when any lane regressed past the
+p99, decode tokens/s + p99s, ...), and exits non-zero when any lane
+regressed past the
 tolerance — the CI-shaped check the session scripts run after a bench
 step so a perf cliff is a red line in the log, not an archaeology
 project (PERF.md history stays the narrative; this is the gate).
@@ -62,6 +63,9 @@ LANES = (
      ("extra", "tfrecord_read", "columnar_records_per_sec"), True),
     ("serve.req_s", ("extra", "serve", "req_per_sec"), True),
     ("serve.p99_ms", ("extra", "serve", "p99_ms"), False),
+    ("decode.tok_s", ("extra", "decode", "tokens_per_sec"), True),
+    ("decode.ttft_p99_ms", ("extra", "decode", "ttft_p99_ms"), False),
+    ("decode.tok_p99_ms", ("extra", "decode", "tok_p99_ms"), False),
     ("elastic.resize_ms", ("extra", "elastic", "resize_ms"), False),
     ("elastic.reshard_ms", ("extra", "elastic", "reshard_ms"), False),
 )

@@ -66,7 +66,12 @@ fi
 if [ "${TFOS_SESSION_SMOKE:-0}" = "1" ]; then
   echo "-- bench.py skipped (smoke mode) --" | tee -a "$log"
 else
-  session_run 7200 python bench.py
+  # serve + decode lanes run host-side on CPU-forced replicas (never a
+  # second TPU claim); TFOS_BENCH_SERVE=0 / TFOS_BENCH_DECODE=0 skip
+  # them if the host is too loaded for meaningful latency percentiles
+  TFOS_BENCH_SERVE="${TFOS_BENCH_SERVE:-1}" \
+  TFOS_BENCH_DECODE="${TFOS_BENCH_DECODE:-1}" \
+    session_run 7200 python bench.py
 fi
 # perf-regression gate: newest BENCH line vs prior round (host-side,
 # no TPU claim; host_run never aborts the session on a red verdict)

@@ -55,7 +55,12 @@ tsteps=${TFOS_SESSION_TRANSFORMER_STEPS:-8}
 if [ "$smoke" = "1" ]; then
   echo "-- bench.py skipped (smoke mode) --" | tee -a "$log"
 else
-  session_run 7200 bash -c 'python bench.py > BENCH_session_r5.json.tmp \
+  # serve + decode lanes are CPU-forced (claim-safe alongside the TPU
+  # claim this step holds); TFOS_BENCH_SERVE=0 / TFOS_BENCH_DECODE=0
+  # to skip
+  TFOS_BENCH_SERVE="${TFOS_BENCH_SERVE:-1}" \
+  TFOS_BENCH_DECODE="${TFOS_BENCH_DECODE:-1}" \
+    session_run 7200 bash -c 'python bench.py > BENCH_session_r5.json.tmp \
     && mv BENCH_session_r5.json.tmp BENCH_session_r5.json \
     && cat BENCH_session_r5.json'
 fi
@@ -95,7 +100,9 @@ TFOS_SWEEP="${TFOS_SESSION_TRANSFORMER_SWEEP:-b64_q512_kv512_rdots_pbwd,b96_q512
 if [ "$smoke" = "1" ]; then
   echo "-- final bench.py skipped (smoke mode) --" | tee -a "$log"
 else
-  session_run 7200 bash -c 'python bench.py > BENCH_session_r5_final.json.tmp \
+  TFOS_BENCH_SERVE="${TFOS_BENCH_SERVE:-1}" \
+  TFOS_BENCH_DECODE="${TFOS_BENCH_DECODE:-1}" \
+    session_run 7200 bash -c 'python bench.py > BENCH_session_r5_final.json.tmp \
     && mv BENCH_session_r5_final.json.tmp BENCH_session_r5_final.json \
     && cat BENCH_session_r5_final.json'
 fi

@@ -1,0 +1,376 @@
+"""Decode-tier tests: slot-paged KV cache units, sequence-length
+bucketing, the open-loop load generator, the CPU parity acceptance gate
+(continuous-batched greedy decode token-identical to full-recompute,
+including mid-flight admission of staggered mixed-length prompts), and
+the Server/HTTP generate surface.  Slow lane: a replica SIGKILLed
+mid-decode (sessions re-prefill on the survivor; zero dropped and zero
+duplicated tokens)."""
+
+import functools
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.serving import batcher as B
+from tensorflowonspark_tpu.serving import decode as D
+from tensorflowonspark_tpu.serving import replicas as R
+from tensorflowonspark_tpu.serving import server as S
+
+pytestmark = pytest.mark.decode
+
+
+def _cfg(**kw):
+    from tensorflowonspark_tpu.models import transformer as T
+    base = dict(vocab_size=61, dim=32, n_layers=2, n_heads=2, max_seq=32,
+                dtype="float32", attn_impl="reference")
+    base.update(kw)
+    return T.Config(**base)
+
+
+def _params(cfg):
+    import jax
+
+    from tensorflowonspark_tpu.models import transformer as T
+    return T.init(jax.random.PRNGKey(0), cfg)
+
+
+def _oracle(params, prompt, cfg, **kw):
+    from tensorflowonspark_tpu import ops
+    from tensorflowonspark_tpu.models import transformer as T
+    return T.greedy_decode_reference(
+        params, prompt, cfg,
+        attn_fn=functools.partial(ops.mha_reference, causal=True), **kw)
+
+
+# --- sequence bucketing (satellite b) ---------------------------------------
+
+def test_bucket_seq_pow2_and_cap():
+    assert [B.bucket_seq(n, 64) for n in (1, 2, 3, 5, 9, 33, 64, 100)] == \
+        [1, 2, 4, 8, 16, 64, 64, 64]
+    # the cap itself is a legal bucket even when not a power of two
+    assert B.bucket_seq(48, 48) == 48
+    assert B.bucket_seq(49, 48) == 48
+    assert B.bucket_seq(3, 48) == 4
+
+
+def test_pad_seq_edge_replication_and_errors():
+    a = np.array([1, 2, 3], dtype=np.int32)
+    p = B.pad_seq(a, 8)
+    assert p.shape == (8,) and (p[3:] == 3).all()
+    assert B.pad_seq(a, 3) is a  # no-op returns the input
+    m = np.arange(6, dtype=np.float32).reshape(2, 3)
+    p2 = B.pad_seq(m, 5, axis=1)
+    assert p2.shape == (2, 5) and (p2[:, 3:] == m[:, -1:]).all()
+    with pytest.raises(ValueError):
+        B.pad_seq(a, 2)  # cannot shrink
+    with pytest.raises(ValueError):
+        B.pad_seq(np.zeros((0,), np.int32), 4)  # nothing to replicate
+    with pytest.raises(ValueError):
+        B.pad_seq(m, 4, axis=2)  # no such axis
+
+
+def test_batcher_seq_bucketing_groups_pads_and_ships_lengths():
+    batches = []
+
+    def dispatch(batch):
+        batches.append(batch)
+        batch.complete({"y": batch.inputs["tokens"]})
+
+    with pytest.raises(ValueError):
+        B.MicroBatcher(dispatch, seq_axis=0)  # seq_axis requires seq_cap
+    mb = B.MicroBatcher(dispatch, max_batch=8, max_delay_ms=50,
+                        queue_max=100, seq_axis=0, seq_cap=16)
+    reqs = [mb.submit({"tokens": np.arange(n, dtype=np.int32)})
+            for n in (3, 5, 7, 9)]
+    mb.start()
+    for r in reqs:
+        r.result(timeout=10)
+    mb.close()
+    # 3 -> bucket 4 alone; 5 and 7 share bucket 8; 9 -> bucket 16
+    shapes = sorted(b.inputs["tokens"].shape for b in batches)
+    assert shapes == [(1, 4), (1, 16), (2, 8)]
+    for b in batches:
+        # true lengths ride alongside as an int32 column; padding is
+        # edge-replicated so padded ids stay in-vocabulary
+        lens = b.inputs["_seq_len"]
+        assert lens.dtype == np.int32
+        for row, n in zip(b.inputs["tokens"], lens):
+            assert (row[:n] == np.arange(n)).all()
+            assert (row[n:] == n - 1).all()
+
+
+# --- open-loop load generator (tentpole harness) ----------------------------
+
+def test_run_open_loop_classifies_and_aggregates():
+    def request_fn(i):
+        if i == 1:
+            raise B.Overloaded(5, 4, retry_after=0.1)
+        if i == 2:
+            raise RuntimeError("boom")
+        time.sleep(0.001)
+        return {"ttft_ms": 5.0 + i, "token_ms": [1.0, 2.0], "tokens": 3}
+
+    stats = D.run_open_loop(request_fn, rate_rps=500, n_requests=8,
+                            seed=7, shed_exc=B.Overloaded)
+    assert stats["requests"] == 8
+    assert stats["completed"] == 6
+    assert stats["shed"] == 1 and stats["errors"] == 1
+    assert stats["tokens"] == 18 and stats["tokens_per_sec"] > 0
+    assert stats["latency_p50_ms"] > 0
+    assert stats["latency_p99_ms"] >= stats["latency_p50_ms"]
+    assert stats["ttft_p50_ms"] >= 5.0
+    assert stats["tok_p50_ms"] in (1.0, 2.0)
+    assert stats["offered_rps"] == 500
+    # seeded arrivals: the same seed replays the same schedule
+    again = D.run_open_loop(request_fn, rate_rps=500, n_requests=8,
+                            seed=7, shed_exc=B.Overloaded)
+    assert again["completed"] == 6 and again["shed"] == 1
+
+
+# --- KV cache units ---------------------------------------------------------
+
+def test_kvcache_slot_lifecycle_and_insert():
+    from tensorflowonspark_tpu.serving.decode import kvcache
+    cfg = _cfg()
+    cache = kvcache.SlotKVCache(cfg, slots=3)
+    assert cache.k.shape == (3, cfg.n_layers, cfg.n_heads, cfg.max_seq,
+                             cfg.dim // cfg.n_heads)
+    assert cache.free_slots == 3 and cache.occupancy == 0
+    got = [cache.alloc() for _ in range(3)]
+    assert got == [0, 1, 2]  # lowest slot first
+    assert cache.alloc() is None  # full
+    k = np.ones((cfg.n_layers, cfg.n_heads, 5, cfg.dim // cfg.n_heads),
+                np.float32)
+    cache.insert(1, k, k, 5)
+    assert cache.lengths[1] == 5 and cache.occupancy == 3
+    cache.retire(1)
+    assert cache.lengths[1] == 0 and cache.free_slots == 1
+    with pytest.raises(ValueError):
+        cache.retire(1)  # double retire
+    assert cache.alloc() == 1  # freed slot is reusable
+
+
+def test_engine_submit_rejects_bad_prompts_via_emit():
+    events = []
+    cfg = _cfg()
+    eng = D.DecodeEngine(params=None, spec=D.DecodeSpec(cfg, slots=2),
+                         emit=lambda kind, sid, *rest: events.append(
+                             (kind, sid) + rest))
+    eng.submit("s-empty", [])
+    eng.submit("s-long", list(range(cfg.max_seq)))
+    kinds = [(k, sid) for k, sid, *_ in events]
+    assert ("error", "s-empty") in kinds and ("error", "s-long") in kinds
+
+
+# --- THE acceptance gate: token-identical continuous batching ---------------
+
+def test_parity_staggered_mixed_length_token_identical():
+    """Seeded multi-request trace with staggered arrivals and mixed
+    prompt lengths; every session's streamed tokens must be
+    token-identical to a full-recompute greedy decode of the same
+    prompt, with each token index emitted exactly once."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.default_rng(3)
+    prompts = {f"s{i}": rng.integers(0, cfg.vocab_size, size=n).tolist()
+               for i, n in enumerate((5, 3, 9, 12))}
+
+    events = {sid: {"tokens": [], "done": None, "error": None}
+              for sid in prompts}
+    lock = threading.Lock()
+
+    def emit(kind, sid, *rest):
+        with lock:
+            if kind == "token":
+                events[sid]["tokens"].append(rest)  # (index, token)
+            elif kind == "done":
+                events[sid]["done"] = rest[0]
+            else:
+                events[sid]["error"] = rest[0]
+
+    eng = D.DecodeEngine(_params(cfg), D.DecodeSpec(cfg, slots=2,
+                                                    max_tokens=6), emit)
+    eng.start(timeout=300)
+    try:
+        # staggered admission: s0 decodes alone first, then the rest
+        # arrive mid-flight (slots=2 also forces queueing)
+        eng.submit("s0", prompts["s0"])
+        deadline = time.time() + 300
+        while not events["s0"]["tokens"] and time.time() < deadline:
+            time.sleep(0.01)
+        assert events["s0"]["tokens"], "no first token within deadline"
+        for sid in ("s1", "s2", "s3"):
+            eng.submit(sid, prompts[sid])
+        while (any(e["done"] is None and e["error"] is None
+                   for e in events.values())
+               and time.time() < deadline):
+            time.sleep(0.01)
+    finally:
+        eng.stop()
+
+    for sid, prompt in prompts.items():
+        ev = events[sid]
+        assert ev["error"] is None, (sid, ev["error"])
+        ref = _oracle(params, prompt, cfg, max_tokens=6)
+        assert ev["done"] == ref, (sid, ev["done"], ref)
+        # streamed (index, token) pairs: exactly once per index, in order
+        idxs = [i for i, _ in ev["tokens"]]
+        assert idxs == list(range(len(ref))), (sid, idxs)
+        assert [t for _, t in ev["tokens"]] == ref, sid
+
+
+def test_parity_eos_stops_early():
+    cfg = _cfg()
+    params = _params(cfg)
+    prompt = [7, 11, 13, 17, 19]
+    free_run = _oracle(params, prompt, cfg, max_tokens=8)
+    eos = free_run[2]  # a token the free run provably emits
+    ref = _oracle(params, prompt, cfg, max_tokens=8, eos_id=eos)
+    # decode stops at (and includes) the FIRST occurrence of eos
+    assert ref == free_run[:free_run.index(eos) + 1]
+
+    events = {}
+    eng = D.DecodeEngine(params, D.DecodeSpec(cfg, slots=2, max_tokens=8),
+                         lambda kind, sid, *rest: events.setdefault(
+                             kind, []).append(rest))
+    eng.start(timeout=300)
+    try:
+        eng.submit("s", prompt, eos_id=eos)
+        deadline = time.time() + 300
+        while "done" not in events and "error" not in events and \
+                time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        eng.stop()
+    assert "error" not in events, events
+    assert events["done"][0][0] == ref
+
+
+# --- Server / HTTP e2e ------------------------------------------------------
+
+def test_server_generate_and_http_roundtrip(tmp_path):
+    import jax
+
+    from tensorflowonspark_tpu.utils import checkpoint as ckpt
+
+    cfg = _cfg()
+    params = _params(cfg)
+    export = str(tmp_path / "export")
+    ckpt.export_model(export, params, metadata={})
+    spec = R.ModelSpec(export_dir=export,
+                       decode=D.DecodeSpec(cfg, slots=4, max_tokens=8))
+    prompt = [2, 3, 5, 7]
+    ref = _oracle(params, prompt, cfg, max_tokens=6)
+    with S.Server(spec, num_replicas=1, request_timeout=300) as srv:
+        out = srv.generate(prompt, max_tokens=6, timeout=300)
+        assert out["tokens"] == ref
+        assert out["ttft_ms"] >= 0
+        # gaps only exist between adjacent streamed tokens
+        assert len(out["token_ms"]) == len(ref) - 1
+        # predict on a decode-only spec is a clear error, not a hang
+        with pytest.raises(Exception):
+            srv.predict({"x": np.ones(1)}, timeout=30)
+        httpd = S.serve_http(srv, port=0, block=False)
+        try:
+            host, port = httpd.server_address
+            req = urllib.request.Request(
+                f"http://{host}:{port}/v1/generate",
+                data=json.dumps({"prompt": prompt,
+                                 "max_tokens": 6}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                assert resp.status == 200
+                doc = json.loads(resp.read())
+            assert doc["tokens"] == ref
+            # malformed body -> 400, not a crash
+            bad = urllib.request.Request(
+                f"http://{host}:{port}/v1/generate",
+                data=json.dumps({"nope": 1}).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(bad, timeout=30)
+            assert ei.value.code == 400
+        finally:
+            httpd.shutdown()
+        summ = srv.summary()
+    dec = summ["decode"]
+    assert dec["completed"] >= 2 and dec["ttft_p99_ms"] >= 0
+
+
+class _GenShedStub:
+    pool = None
+
+    def generate(self, prompt, max_tokens=None, eos_id=None, timeout=None):
+        raise B.Overloaded(65, 64, retry_after=0.5)
+
+
+def test_http_generate_overload_maps_to_503():
+    httpd = S.serve_http(_GenShedStub(), port=0, block=False)
+    try:
+        host, port = httpd.server_address
+        req = urllib.request.Request(
+            f"http://{host}:{port}/v1/generate",
+            data=json.dumps({"prompt": [1, 2]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 503
+        assert float(ei.value.headers["Retry-After"]) == pytest.approx(0.5)
+    finally:
+        httpd.shutdown()
+
+
+# --- slow lane: replica SIGKILL mid-decode (satellite c) --------------------
+
+@pytest.mark.slow
+def test_replica_sigkill_mid_decode_zero_drop_zero_dup(tmp_path):
+    """A 2-replica decode service survives one SIGKILLed replica with
+    sessions in flight: orphans re-prefill on the survivor and the
+    resolve-once ledger dedupes the replayed stream, so every session
+    still returns the exact oracle tokens — zero dropped, zero
+    duplicated."""
+    from tensorflowonspark_tpu.utils import checkpoint as ckpt
+
+    cfg = _cfg()
+    params = _params(cfg)
+    export = str(tmp_path / "export")
+    ckpt.export_model(export, params, metadata={})
+    spec = R.ModelSpec(export_dir=export,
+                       decode=D.DecodeSpec(cfg, slots=4, max_tokens=24))
+    rng = np.random.default_rng(11)
+    with S.Server(spec, num_replicas=2, request_timeout=300) as srv:
+        # warm both replicas' compile caches first so the kill lands
+        # mid-stream, not mid-compile
+        srv.generate([1, 2, 3], max_tokens=2, timeout=300)
+        results, errors = {}, {}
+
+        def one(i):
+            p = rng.integers(0, cfg.vocab_size, size=3 + i % 5).tolist()
+            try:
+                results[i] = (p, srv.generate(p, max_tokens=20,
+                                              timeout=300))
+            except Exception as e:  # noqa: BLE001 - asserted below
+                errors[i] = e
+
+        ts = [threading.Thread(target=one, args=(i,)) for i in range(6)]
+        for t in ts:
+            t.start()
+        deadline = time.time() + 120
+        while srv.pool.outstanding_sessions() < 3 and \
+                time.time() < deadline:
+            time.sleep(0.01)
+        pids = srv.pool.replica_pids()
+        os.kill(pids[sorted(pids)[0]], 9)
+        for t in ts:
+            t.join()
+        assert not errors, errors
+        assert len(results) == 6
+        for i, (p, out) in results.items():
+            ref = _oracle(params, p, cfg, max_tokens=20)
+            assert out["tokens"] == ref, (i, out["tokens"], ref)
