@@ -1,0 +1,27 @@
+"""Supervised-actor substrate (ISSUE 10; ROADMAP item 5).
+
+One generic supervised-worker runtime — typed mailboxes, KV-heartbeat
+liveness, declarative supervision policy, resolve-once delivery ledgers,
+a single fault surface — on which the engine, serving and data tiers are
+thin policy layers.  See ``docs/actors.md``.
+
+Parity anchor: the reference delegates supervision to Spark's executor
+runtime (SURVEY §1); the shape here follows TF's distributed runtime
+(arxiv 1605.08695) and the tf.data service (arxiv 2101.12127).
+"""
+
+from tensorflowonspark_tpu.actors.ledger import (  # noqa: F401
+    DeliveryLedger, IndexLedger, KVLedger, NullLedgerClient, OnceGate,
+    ResolveOnce, resume_cursor,
+)
+from tensorflowonspark_tpu.actors.mailbox import MailboxFull  # noqa: F401
+from tensorflowonspark_tpu.actors.policy import (  # noqa: F401
+    SupervisionPolicy,
+)
+from tensorflowonspark_tpu.actors.supervise import (  # noqa: F401
+    BudgetExhausted, RespawnBudget, RetrySchedule, reap_orphans,
+)
+from tensorflowonspark_tpu.actors.runtime import (  # noqa: F401
+    Actor, ActorContext, ActorGroup, ActorSystem, AskFuture, EchoActor,
+    actor_table,
+)

@@ -1,0 +1,255 @@
+"""Resolve-once futures and exactly-once delivery ledgers — THE copy.
+
+Parity anchor: the reference's only exactly-once accounting is Spark's
+task-retry bookkeeping (reference ``TFSparkNode.py:448-515`` relies on
+"a partition is re-fed whole if its task died"); this repo grew three
+independent refinements of that idea — the rendezvous feed ledger
+(PDONE/PQUERY), the serving batch resolve-once (``batcher.Batch`` /
+``PendingResult``) and the decode token ledger
+(``decode/scheduler.PendingSession``).  This module is the single
+implementation all of them now delegate to (ISSUE 10 satellite:
+"no bespoke respawn/ledger code outside actors/",
+``tests/test_actors.py::test_no_bespoke_supervision_outside_actors``).
+
+Three primitives, composable:
+
+- :class:`OnceGate` — a claim that exactly one caller wins (the
+  duplicate-answer guard of a re-dispatched unit of work).
+- :class:`ResolveOnce` — a thread-safe future whose first ``resolve`` /
+  ``reject`` wins; later calls are no-ops.  A re-dispatched request
+  answered by both the dead owner's inherited queue and the survivor
+  resolves exactly once by construction.
+- :class:`IndexLedger` — first-arrival-wins values keyed by a dense
+  index (streaming token ledger): a deterministic replay after a
+  failover re-delivers identical ``(index, value)`` pairs and the
+  ledger keeps the originals (timestamps included, so latency stats
+  survive the failover).
+- :class:`DeliveryLedger` — named done-sets (``feed -> {unit}``): the
+  PDONE/PQUERY table.  :class:`KVLedger` is the same contract persisted
+  in a manager KV (one key per unit — no read-modify-write race), which
+  survives the *recording* process's death: an actor respawn resumes
+  past everything already recorded.
+
+Stdlib-only: imported by engine executors, replicas, data workers and
+the driver alike.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class OnceGate:
+    """First ``claim()`` returns True, every later one False."""
+
+    __slots__ = ("_lock", "_claimed")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._claimed = False
+
+    def claim(self):
+        with self._lock:
+            if self._claimed:
+                return False
+            self._claimed = True
+            return True
+
+    def claimed(self):
+        with self._lock:
+            return self._claimed
+
+
+class ResolveOnce:
+    """A thread-safe future: the first ``resolve``/``reject`` wins.
+
+    Subclasses add domain payloads (request example, session prompt);
+    the resolution discipline — and therefore the zero-drop/zero-dup
+    failover argument — lives here, once.
+    """
+
+    __slots__ = ("_event", "_value", "_error")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._error = None
+
+    def done(self):
+        return self._event.is_set()
+
+    def resolve(self, value):
+        """Resolve with ``value``; True iff this call won the race."""
+        if self._event.is_set():
+            return False
+        self._value = value
+        self._event.set()
+        return True
+
+    def reject(self, exc):
+        """Resolve exceptionally; True iff this call won the race."""
+        if self._event.is_set():
+            return False
+        self._error = exc
+        self._event.set()
+        return True
+
+    def wait(self, timeout, what="result not available"):
+        """Block for the value; raises the stored error, or
+        ``TimeoutError`` ("``{what}`` within ``{timeout}``s") — callers
+        phrase ``what`` as the failure ("request not served")."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"{what} within {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class IndexLedger:
+    """First-arrival-wins values keyed by index, timestamps kept."""
+
+    __slots__ = ("_lock", "_values", "_times")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._values = {}
+        self._times = {}
+
+    def record(self, index, value):
+        """Record ``value`` at ``index``; True iff it was the first."""
+        with self._lock:
+            if index in self._values:
+                return False
+            self._values[index] = value
+            self._times[index] = time.perf_counter()
+            return True
+
+    def values(self):
+        """Recorded values in index order."""
+        with self._lock:
+            return [self._values[i] for i in sorted(self._values)]
+
+    def times(self):
+        """{index: perf_counter-of-first-arrival} copy."""
+        with self._lock:
+            return dict(self._times)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._values)
+
+
+class DeliveryLedger:
+    """Named done-sets: ``record(feed, unit)`` / ``done_units(feed)``.
+
+    The in-memory form of the PDONE/PQUERY feed ledger
+    (``rendezvous.Server`` holds one; the data service and recovery
+    re-feed only what is NOT recorded)."""
+
+    __slots__ = ("_lock", "_done")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._done = {}
+
+    def record(self, feed, unit):
+        """Mark ``unit`` done for ``feed``; True iff newly recorded."""
+        with self._lock:
+            units = self._done.setdefault(str(feed), set())
+            if unit in units:
+                return False
+            units.add(unit)
+            return True
+
+    def done(self, feed, unit):
+        with self._lock:
+            return unit in self._done.get(str(feed), ())
+
+    def done_units(self, feed):
+        """Sorted units recorded done for ``feed``."""
+        with self._lock:
+            return sorted(self._done.get(str(feed), ()))
+
+    def reset(self, feed):
+        """Forget ``feed``'s done-set (one replay scope per owner)."""
+        with self._lock:
+            self._done.pop(str(feed), None)
+
+    def items(self):
+        """[(feed, frozenset(units))] snapshot (introspection/statusz)."""
+        with self._lock:
+            return sorted((f, frozenset(u)) for f, u in self._done.items())
+
+    def __len__(self):
+        with self._lock:
+            return len(self._done)
+
+    def __bool__(self):
+        with self._lock:
+            return bool(self._done)
+
+
+class KVLedger:
+    """A :class:`DeliveryLedger` persisted in a manager KV store.
+
+    One KV key per ``(feed, unit)`` — writes are idempotent and never
+    read-modify-write, so concurrent recorders cannot race.  The KV
+    lives in the driver-owned manager server process, so the ledger
+    survives the recording actor's death: a respawned incarnation skips
+    everything already recorded (the eval sidecar's exactly-once
+    argument, ``workloads/eval_sidecar.py``).
+    """
+
+    __slots__ = ("_mgr", "_prefix")
+
+    def __init__(self, mgr, namespace):
+        self._mgr = mgr
+        self._prefix = f"actor_ledger:{namespace}:"
+
+    def _key(self, feed, unit):
+        return f"{self._prefix}{feed}:{unit!r}"
+
+    def record(self, feed, unit):
+        if self.done(feed, unit):
+            return False
+        self._mgr.set(self._key(feed, unit), unit)
+        return True
+
+    def done(self, feed, unit):
+        try:
+            return self._mgr.get(self._key(feed, unit)) is not None
+        except Exception:  # noqa: BLE001 - manager tearing down
+            return False
+
+    def done_units(self, feed):
+        want = f"{self._prefix}{feed}:"
+        try:
+            items = self._mgr.kv().items()
+        except Exception:  # noqa: BLE001 - manager tearing down
+            return []
+        return sorted(v for k, v in items if str(k).startswith(want))
+
+
+class NullLedgerClient:
+    """Ledger stand-in when no rendezvous server is reachable
+    (standalone DataService / actor use in tests and benches)."""
+
+    def fed_partitions(self, feed):
+        return []
+
+    def partition_done(self, feed, part):
+        pass
+
+    def close(self):
+        pass
+
+
+def resume_cursor(done_units, start=0):
+    """First unit index >= ``start`` NOT in ``done_units`` — the shard
+    cursor a respawned worker resumes at (data/service.py contract)."""
+    done = set(done_units)
+    unit = int(start)
+    while unit in done:
+        unit += 1
+    return unit

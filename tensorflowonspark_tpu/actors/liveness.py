@@ -1,0 +1,84 @@
+"""Uniform KV-heartbeat liveness — the keyed form of ``manager.beat``.
+
+Parity anchor: the reference's only liveness signal is Spark's executor
+heartbeat to the driver (SURVEY §1); this repo's trainer heartbeat
+(``manager.beat``, single well-known key) grew a keyed sibling inside
+``serving/replicas.py`` so N replicas could beat through one manager.
+This module is that keyed form extracted once, used by every actor —
+replica tasks, data workers and any user actor get the identical
+beat/age/scan discipline with no per-tier thread code.
+
+The cadence and staleness threshold come from
+``manager.heartbeat_interval()`` / ``manager.stale_after()`` — the
+``TFOS_ACTOR_HEARTBEAT_*`` env family (legacy ``TFOS_HEARTBEAT_*``
+aliases honored), see ``actors/policy.py``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from tensorflowonspark_tpu import manager as tfmanager
+
+__all__ = ["beat", "beat_age", "start_heartbeat", "scan"]
+
+
+def beat(mgr, key):
+    """Record liveness under ``key`` now (KV write = proof of scheduling)."""
+    mgr.set(key, time.time())
+
+
+def beat_age(mgr, key):
+    """Seconds since the last beat under ``key``; None = never beat (or
+    KV unreadable) — callers treat None as 'unknown', never 'dead'."""
+    try:
+        v = mgr.get(key)
+    except Exception:  # noqa: BLE001 - manager tearing down
+        return None
+    if v is None:
+        return None
+    try:
+        return max(0.0, time.time() - float(v))
+    except (TypeError, ValueError):
+        return None
+
+
+def start_heartbeat(mgr, key, interval=None):
+    """Daemon thread beating ``key`` every ``interval`` (default:
+    ``manager.heartbeat_interval()``); returns a stop Event.  The thread
+    exits silently when the manager goes away — the process is ending."""
+    interval = (tfmanager.heartbeat_interval() if interval is None
+                else float(interval))
+    stop = threading.Event()
+
+    def _run():
+        while not stop.is_set():
+            try:
+                beat(mgr, key)
+            except Exception:  # noqa: BLE001 - manager gone: member exiting
+                return
+            stop.wait(interval)
+
+    threading.Thread(target=_run, name="tfos-actor-beat",
+                     daemon=True).start()
+    return stop
+
+
+def scan(indices, proc_alive, age_of, stale_secs):
+    """One liveness sweep: ``[(idx, why)]`` members to declare lost.
+
+    ``proc_alive(idx)`` is the fast path (executor process death);
+    ``age_of(idx)`` the wedged-member path (beating stopped while the
+    process lives).  A member is lost on either signal — the same two
+    signals engine/node supervision uses.
+    """
+    lost = []
+    for idx in indices:
+        if not proc_alive(idx):
+            lost.append((idx, "process death"))
+            continue
+        age = age_of(idx)
+        if age is not None and age > stale_secs:
+            lost.append((idx, f"heartbeat stale ({age:.1f}s)"))
+    return lost

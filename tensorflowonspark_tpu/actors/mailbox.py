@@ -1,0 +1,91 @@
+"""Typed mailboxes over the manager queue wire: envelopes + backpressure.
+
+Parity anchor: the reference's only executor-directed messaging is the
+driver pushing shutdown markers into per-executor manager queues
+(reference ``TFCluster.py:186-194``); this repo's serving pool extended
+that into a real request/reply wire (``serve_in_{i}`` / ``serve_out``).
+This module names that wire's envelope grammar once, adds the two things
+every tier re-derived by hand — request ids for reply correlation and a
+bounded-depth send — and leaves transport to ``manager.TFManager``
+queues (loopback TCP proxies; a queue name IS a mailbox).
+
+Envelope grammar (plain tuples — cloudpickle-free on the control path):
+
+driver -> actor (per-member in-queue)::
+
+    ("tell", epoch, kind, blob)           one-way, no reply
+    ("ask",  epoch, req_id, kind, blob)   reply expected on the out-queue
+    ("stop",)                             drain & exit
+
+actor -> driver (shared group out-queue)::
+
+    ("up", idx, pid, epoch)               mailbox loop entered
+    ("reply", idx, req_id, ok, blob)      ask answer (ok=False: traceback)
+    ("event", idx, kind, blob)            unsolicited notification
+    ("init_error", idx, repr)             on_start raised
+    ("down", idx)                         clean exit
+
+Epoch fencing: every driver->actor envelope carries the sender's epoch
+for that member; a member drops envelopes from epochs OLDER than its
+boot epoch (a bumped epoch fences the dead incarnation's inherited
+mail), and accepts current-or-newer (a respawn that raced the bump must
+not drop re-stamped work).  Replies correlate by ``req_id`` into a
+resolve-once future (``actors.ledger.ResolveOnce``), so a duplicate
+answer — old incarnation's inherited copy plus the re-dispatched one —
+resolves exactly once.
+"""
+
+from __future__ import annotations
+
+__all__ = ["MailboxFull", "in_queue", "out_queue", "beat_key", "epoch_key",
+           "checked_put"]
+
+
+class MailboxFull(RuntimeError):
+    """A bounded mailbox rejected a send (backpressure, not an outage).
+
+    Mirrors the serving front door's ``Overloaded`` contract: carries
+    the observed depth and the limit so callers can shed or retry."""
+
+    def __init__(self, name, depth, limit):
+        super().__init__(
+            f"mailbox {name} is full ({depth} >= limit {limit}); "
+            "receiver is not keeping up — retry later or raise "
+            "TFOS_ACTOR_MAILBOX_DEPTH")
+        self.name = name
+        self.depth = depth
+        self.limit = limit
+
+
+def in_queue(group, idx):
+    """Manager queue name of member ``idx``'s mailbox."""
+    return f"actor_in:{group}:{idx}"
+
+
+def out_queue(group):
+    """Manager queue name of the group's shared driver-bound queue."""
+    return f"actor_out:{group}"
+
+
+def beat_key(group, idx):
+    """Manager KV key member ``idx`` heartbeats under."""
+    return f"actor_beat:{group}:{idx}"
+
+
+def epoch_key(group, idx):
+    """Manager KV key holding member ``idx``'s current epoch."""
+    return f"actor_epoch:{group}:{idx}"
+
+
+def checked_put(q, name, envelope, depth_limit):
+    """Backpressured send: raises :class:`MailboxFull` instead of
+    queueing past ``depth_limit``.  Returns the observed depth (the
+    mailbox-depth gauge's sample)."""
+    try:
+        depth = q.qsize()
+    except Exception:  # noqa: BLE001 - proxy without qsize support
+        depth = 0
+    if depth_limit and depth >= depth_limit:
+        raise MailboxFull(name, depth, depth_limit)
+    q.put(envelope)
+    return depth + 1

@@ -1,0 +1,664 @@
+"""Supervised-actor runtime: spawn, mailboxes, liveness, failover.
+
+Parity anchor: the reference delegates ALL process supervision to
+Spark's executor runtime (SURVEY §1; reference ``TFSparkNode.py`` just
+assumes a re-run task lands somewhere and reattaches).  The TPU-native
+stack needs its own, and both TF's distributed runtime (PAPERS.md arxiv
+1605.08695 — a generic dataflow worker + one service protocol) and the
+tf.data service (arxiv 2101.12127 — dispatcher/worker with heartbeats
+and task ledgers) show the winning shape: ONE generic supervised-worker
+substrate with typed RPC, on which every tier is a thin policy layer.
+
+This module is that substrate.  An :class:`Actor` subclass defines
+behavior (``on_start/on_message/on_tick/on_stop``); an
+:class:`ActorSystem` places N members of it on ``LocalEngine`` executor
+slots and supervises them:
+
+- **spawn/respawn** ride the engine's retryable-task machinery
+  (``foreach_partition(placement=..., retryable=True)``): a SIGKILLed
+  member is respawned by engine supervision and its task blob
+  re-dispatched byte-identically — the exact mechanism the serving
+  replica pool proved out.
+- **liveness** is the keyed manager-KV heartbeat (``actors.liveness``)
+  plus direct executor-process checks; a wedged member (beating stopped,
+  process alive) is killed so the engine path takes over.
+- **mailboxes** are manager queues with the ``actors.mailbox`` envelope
+  grammar: bounded ``tell`` / ``ask`` with epoch fencing; replies
+  resolve :class:`~tensorflowonspark_tpu.actors.ledger.ResolveOnce`
+  futures, so re-dispatched asks answered twice resolve exactly once.
+- **policy** is declarative per group
+  (:class:`~tensorflowonspark_tpu.actors.policy.SupervisionPolicy`).
+- **fault injection**: ``TFOS_FAULT_PLAN`` sites ``actor.spawn`` /
+  ``actor.receive`` / ``actor.tick`` fire inside the member loop.
+
+See ``docs/actors.md`` for the supervision model and how to write one.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue as _queue
+import signal
+import threading
+import time
+import traceback
+import weakref
+
+import cloudpickle
+
+from tensorflowonspark_tpu import manager as tfmanager
+from tensorflowonspark_tpu.actors import ledger as _ledger
+from tensorflowonspark_tpu.actors import liveness, mailbox
+from tensorflowonspark_tpu.actors.dispatch import InFlightTable
+from tensorflowonspark_tpu.actors.policy import SupervisionPolicy
+from tensorflowonspark_tpu.utils import faults, metrics_registry, telemetry
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["Actor", "ActorContext", "ActorSystem", "ActorGroup",
+           "AskFuture", "EchoActor", "actor_table"]
+
+
+class Actor:
+    """Behavior of one supervised member.  Subclass and override; the
+    instance is cloudpickled to every member, so keep state picklable
+    (per-member state diverges after spawn)."""
+
+    def on_start(self, ctx):
+        """Runs once per incarnation, before the mailbox loop."""
+
+    def on_message(self, ctx, kind, payload):
+        """Handle one ``tell``/``ask`` envelope; the return value is the
+        ask reply.  May be re-invoked for the same logical message after
+        a failover (at-least-once); use ``ctx.ledger`` for exactly-once
+        effects."""
+        raise NotImplementedError(f"unhandled message kind {kind!r}")
+
+    def on_tick(self, ctx):
+        """Runs when the mailbox is idle for ``policy.tick_secs``."""
+
+    def on_stop(self, ctx):
+        """Runs on clean shutdown (never on SIGKILL — by definition)."""
+
+
+class ActorContext:
+    """What a running member sees: identity, the manager KV, and an
+    exactly-once ledger surviving its own death."""
+
+    __slots__ = ("group", "index", "epoch", "mgr", "ledger", "_outq")
+
+    def __init__(self, group, index, epoch, mgr, outq):
+        self.group = group
+        self.index = index
+        self.epoch = epoch
+        self.mgr = mgr
+        #: KV-backed exactly-once ledger namespaced by group: an effect
+        #: recorded here is skipped by every later incarnation.
+        self.ledger = _ledger.KVLedger(mgr, group)
+        self._outq = outq
+
+    def kv_get(self, key):
+        return self.mgr.get(f"actor_kv:{self.group}:{key}")
+
+    def kv_set(self, key, value):
+        self.mgr.set(f"actor_kv:{self.group}:{key}", value)
+
+    def emit(self, kind, payload=None):
+        """Unsolicited notification to the driver (group ``events``)."""
+        self._outq.put(("event", self.index, kind,
+                        cloudpickle.dumps(payload)))
+
+
+class EchoActor(Actor):
+    """Test/bench actor: echoes asks; ``pid``/``sleep``/``crash`` kinds
+    exercise identity, slowness and SIGKILL-failover paths."""
+
+    def __init__(self):
+        self.ticks = 0
+
+    def on_tick(self, ctx):
+        self.ticks += 1
+
+    def on_message(self, ctx, kind, payload):
+        if kind == "pid":
+            return os.getpid()
+        if kind == "sleep":
+            time.sleep(float(payload))
+            return payload
+        if kind == "crash":
+            os.kill(os.getpid(), signal.SIGKILL)
+        if kind == "ticks":
+            return self.ticks
+        return payload
+
+
+def _make_actor_task(actor_blob, policy_blob, group, mgr_addr, mgr_authkey):
+    """The engine task every member runs.  A real module-level factory
+    (not a heredoc/driver lambda): the closure is cloudpickled into the
+    executor and must resolve this module by import there."""
+
+    def _actor_task(it):
+        items = list(it)
+        idx = int(os.environ.get(
+            "TFOS_PARTITION_INDEX", items[0] if items else 0))
+        mgr = tfmanager.connect(mgr_addr, mgr_authkey)
+        inq = mgr.get_queue(mailbox.in_queue(group, idx))
+        outq = mgr.get_queue(mailbox.out_queue(group))
+        telemetry.configure(node_id=f"actor-{group}-{idx}", role="actor")
+        try:
+            faults.check("actor.spawn", group=group, actor=idx)
+            actor = cloudpickle.loads(actor_blob)
+            policy = cloudpickle.loads(policy_blob)
+            # The boot epoch fences the PREVIOUS incarnation's inherited
+            # mail: the supervisor bumps the KV before this respawn, so
+            # envelopes stamped older than it are the dead twin's.
+            epoch = int(mgr.get(mailbox.epoch_key(group, idx)) or 0)
+            ctx = ActorContext(group, idx, epoch, mgr, outq)
+            actor.on_start(ctx)
+        except BaseException as e:  # noqa: BLE001 - report, then fail task
+            outq.put(("init_error", idx, repr(e)))
+            raise
+        stop_beat = liveness.start_heartbeat(
+            mgr, mailbox.beat_key(group, idx), policy.heartbeat_secs)
+        outq.put(("up", idx, os.getpid(), epoch))
+        try:
+            while True:
+                try:
+                    msg = inq.get(timeout=policy.tick_secs)
+                except _queue.Empty:
+                    faults.check("actor.tick", group=group, actor=idx)
+                    try:
+                        actor.on_tick(ctx)
+                    except Exception:  # noqa: BLE001 - tick must not kill
+                        logger.exception("actor %s[%d] on_tick failed",
+                                         group, idx)
+                        outq.put(("event", idx, "tick_error",
+                                  cloudpickle.dumps(traceback.format_exc())))
+                    continue
+                kind = msg[0]
+                if kind == "stop":
+                    break
+                if kind == "tell":
+                    _, m_epoch, m_kind, blob = msg
+                    if policy.epoch_fencing and m_epoch < epoch:
+                        continue  # dead incarnation's inherited mail
+                    try:
+                        faults.check("actor.receive", group=group,
+                                     actor=idx, msg=m_kind)
+                        with telemetry.span(telemetry.ACTOR_MESSAGE,
+                                            group=group, actor=idx,
+                                            kind=m_kind, ask=False):
+                            actor.on_message(ctx, m_kind,
+                                             cloudpickle.loads(blob))
+                    except Exception:  # noqa: BLE001 - one bad tell must
+                        # not take the member down
+                        logger.exception("actor %s[%d] failed tell %r",
+                                         group, idx, m_kind)
+                        outq.put(("event", idx, "tell_error",
+                                  cloudpickle.dumps(traceback.format_exc())))
+                elif kind == "ask":
+                    _, m_epoch, req_id, m_kind, blob = msg
+                    if policy.epoch_fencing and m_epoch < epoch:
+                        # fenced: the supervisor re-stamped and re-sent a
+                        # copy; answering this one too would be harmless
+                        # (resolve-once) but wastes the device
+                        continue
+                    try:
+                        faults.check("actor.receive", group=group,
+                                     actor=idx, msg=m_kind)
+                        with telemetry.span(telemetry.ACTOR_MESSAGE,
+                                            group=group, actor=idx,
+                                            kind=m_kind, ask=True):
+                            out = actor.on_message(ctx, m_kind,
+                                                   cloudpickle.loads(blob))
+                        outq.put(("reply", idx, req_id, True,
+                                  cloudpickle.dumps(out)))
+                    except BaseException:  # noqa: BLE001 - the asker gets
+                        # the traceback; the member keeps serving
+                        outq.put(("reply", idx, req_id, False,
+                                  cloudpickle.dumps(traceback.format_exc())))
+        finally:
+            stop_beat.set()
+            try:
+                actor.on_stop(ctx)
+            except Exception:  # noqa: BLE001 - teardown
+                logger.exception("actor %s[%d] on_stop failed", group, idx)
+            outq.put(("down", idx))
+            telemetry.flush()
+
+    return _actor_task
+
+
+class AskFuture(_ledger.ResolveOnce):
+    """A pending ask reply.  ``result(timeout)`` blocks; re-dispatched
+    asks answered by two incarnations resolve exactly once."""
+
+    __slots__ = ("req_id",)
+
+    def __init__(self, req_id):
+        super().__init__()
+        self.req_id = req_id
+
+    def result(self, timeout=60.0):
+        return self.wait(timeout, "actor reply not delivered")
+
+
+class ActorGroup:
+    """N supervised members of one actor class.  Created by
+    :meth:`ActorSystem.spawn`; the driver-facing handle."""
+
+    def __init__(self, system, name, actor, count, policy, slots):
+        self.name = name
+        self.count = count
+        self.policy = policy
+        self.slots = list(slots)          # member idx -> engine slot
+        self._system = system
+        self._mgr = system._mgr
+        self._inqs = {i: self._mgr.get_queue(mailbox.in_queue(name, i))
+                      for i in range(count)}
+        self._outq = self._mgr.get_queue(mailbox.out_queue(name))
+        self._table = InFlightTable(count)
+        self._epochs = {i: 0 for i in range(count)}
+        self._epoch_lock = threading.Lock()
+        self._req_counter = 0
+        self._registered = threading.Event()
+        self._stop = threading.Event()
+        self._job_error = None
+        self._init_errors = []
+        self.events = []                  # [(idx, kind, payload)] tail
+        self.spawns_observed = 0
+        self.respawns_observed = 0
+        self._threads = []
+        blob = cloudpickle.dumps(actor)
+        pblob = cloudpickle.dumps(policy)
+        self._task = _make_actor_task(
+            blob, pblob, name, tuple(self._mgr.address), system._authkey)
+
+    # -- lifecycle -----------------------------------------------------------
+    def _start(self, timeout):
+        def _launch():
+            try:
+                ds = self._system._engine.parallelize(
+                    list(range(self.count)), self.count)
+                ds.foreach_partition(
+                    self._task, placement=self.slots, retryable=True,
+                    max_retries=self.policy.respawns)
+            except BaseException as e:  # noqa: BLE001 - surfaced below
+                self._job_error = e
+                logger.error("actor group %s job failed: %s", self.name, e)
+
+        for name, target in ((f"tfos-actors-{self.name}-launch", _launch),
+                             (f"tfos-actors-{self.name}-collect",
+                              self._collect),
+                             (f"tfos-actors-{self.name}-monitor",
+                              self._monitor)):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._job_error is not None:
+                raise RuntimeError(
+                    f"actor group {self.name} failed to start: "
+                    f"{self._job_error}")
+            if self._init_errors:
+                raise RuntimeError(
+                    f"actor group {self.name} failed to start: "
+                    f"{self._init_errors[0]}")
+            if len(self._table.live()) >= self.count:
+                return self
+            self._registered.wait(0.2)
+            self._registered.clear()
+        raise TimeoutError(
+            f"actor group {self.name} not up within {timeout}s "
+            f"({len(self._table.live())}/{self.count})")
+
+    def stop(self):
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        err = RuntimeError(f"actor group {self.name} stopped")
+        for _key, entry in self._table.drain():
+            entry["future"].reject(err)
+        for inq in self._inqs.values():
+            try:
+                inq.put(("stop",))
+            except Exception:  # noqa: BLE001 - manager may be gone
+                pass
+        for t in self._threads:
+            if t.name.endswith("-launch"):
+                t.join(timeout=15)
+
+    # -- messaging -----------------------------------------------------------
+    def _pick(self, index):
+        if index is not None:
+            return int(index)
+        live = self._table.live() or list(range(self.count))
+        loads = self._table.loads()
+        return min(live, key=lambda i: (loads.get(i, 0), i))
+
+    def _send(self, idx, envelope):
+        depth = mailbox.checked_put(
+            self._inqs[idx], mailbox.in_queue(self.name, idx), envelope,
+            self.policy.mailbox_depth)
+        metrics_registry.set_gauge("tfos_actor_mailbox_depth", depth,
+                                   group=self.name)
+
+    def tell(self, kind, payload=None, index=None):
+        """One-way send to ``index`` (default: least-loaded live member).
+        Raises :class:`~.mailbox.MailboxFull` past the depth bound."""
+        self._raise_if_dead()
+        idx = self._pick(index)
+        with self._epoch_lock:
+            epoch = self._epochs[idx]
+        self._send(idx, ("tell", epoch, kind, cloudpickle.dumps(payload)))
+        return idx
+
+    def ask(self, kind, payload=None, index=None):
+        """Request/reply: returns an :class:`AskFuture`.  A member lost
+        mid-flight gets its asks re-dispatched to survivors (or
+        re-stamped for its own respawn); the future resolves once."""
+        self._raise_if_dead()
+        blob = cloudpickle.dumps(payload)
+        with self._epoch_lock:
+            self._req_counter += 1
+            req_id = self._req_counter
+        future = AskFuture(req_id)
+        idx = self._table.add(
+            req_id, {"future": future, "kind": kind, "blob": blob},
+            owner=(None if index is None else int(index)))
+        with self._epoch_lock:
+            epoch = self._epochs[idx]
+        try:
+            self._send(idx, ("ask", epoch, req_id, kind, blob))
+        except BaseException:
+            self._table.pop(req_id)
+            raise
+        return future
+
+    def broadcast(self, kind, payload=None):
+        """Tell every live member; returns the indices reached."""
+        reached = []
+        for idx in self._table.live():
+            try:
+                self.tell(kind, payload, index=idx)
+                reached.append(idx)
+            except mailbox.MailboxFull:
+                pass
+        return reached
+
+    def _raise_if_dead(self):
+        if self._job_error is not None and not self._table.live():
+            raise RuntimeError(
+                f"actor group {self.name} has no members left "
+                f"(job failed: {self._job_error})")
+
+    # -- background threads ---------------------------------------------------
+    def _collect(self):
+        """Drain the group out-queue: registrations, replies, events."""
+        while not self._stop.is_set():
+            try:
+                msg = self._outq.get(timeout=0.25)
+            except _queue.Empty:
+                continue
+            except Exception:  # noqa: BLE001 - manager shut down
+                return
+            kind = msg[0]
+            if kind == "up":
+                _, idx, pid, epoch = msg
+                respawned = self._table.up(idx, pid)
+                self.spawns_observed += 1
+                metrics_registry.inc("tfos_actor_spawns_total",
+                                     group=self.name)
+                self._registered.set()
+                telemetry.event("actor/up", group=self.name, actor=idx,
+                                pid=pid, epoch=epoch)
+                if respawned:
+                    self.respawns_observed += 1
+                    metrics_registry.inc("tfos_actor_respawns_total",
+                                         group=self.name)
+                    telemetry.event("actor/respawn", group=self.name,
+                                    actor=idx, pid=pid, epoch=epoch)
+                    # A respawn can beat the monitor's death poll, so
+                    # this is the authoritative failover trigger: the
+                    # dead incarnation's popped asks are gone; queued
+                    # ones will at worst be answered twice (futures
+                    # resolve once).  Re-dispatch everything it owned.
+                    self._redispatch({idx})
+            elif kind == "reply":
+                _, idx, req_id, ok, blob = msg
+                entry = self._table.pop(req_id)
+                if entry is None:
+                    continue  # duplicate answer after a re-dispatch
+                try:
+                    value = cloudpickle.loads(blob)
+                except Exception as e:  # noqa: BLE001
+                    entry["future"].reject(e)
+                    continue
+                if ok:
+                    entry["future"].resolve(value)
+                else:
+                    entry["future"].reject(RuntimeError(
+                        f"actor {self.name}[{idx}] failed "
+                        f"{entry['kind']!r}:\n{value}"))
+            elif kind == "event":
+                _, idx, ekind, blob = msg
+                try:
+                    payload = cloudpickle.loads(blob)
+                except Exception:  # noqa: BLE001
+                    payload = None
+                self.events.append((idx, ekind, payload))
+                del self.events[:-256]
+            elif kind == "init_error":
+                self._init_errors.append(msg[2])
+                logger.warning("actor %s[%s] init_error: %s",
+                               self.name, msg[1], msg[2])
+            elif kind == "down":
+                self._table.down(msg[1])
+
+    def _monitor(self):
+        """Liveness sweep: engine-process death (fast path) and stale KV
+        heartbeats (wedged-member path).  A wedged member is killed so
+        the engine's respawn machinery takes over; either way the epoch
+        is bumped (fencing its inherited mail) and its in-flight asks
+        re-dispatched."""
+        while not self._stop.wait(0.2):
+            live = self._table.live()
+            lost = liveness.scan(
+                live, self._proc_alive,
+                lambda i: liveness.beat_age(
+                    self._mgr, mailbox.beat_key(self.name, i)),
+                self.policy.stale_secs)
+            ages = [liveness.beat_age(self._mgr,
+                                      mailbox.beat_key(self.name, i))
+                    for i in live]
+            known = [a for a in ages if a is not None]
+            if known and metrics_registry.enabled():
+                metrics_registry.set_gauge("tfos_actor_heartbeat_age_s",
+                                           max(known), group=self.name)
+            for idx, why in lost:
+                self._table.lost(idx)
+                with self._epoch_lock:
+                    self._epochs[idx] += 1
+                    epoch = self._epochs[idx]
+                try:
+                    self._mgr.set(mailbox.epoch_key(self.name, idx), epoch)
+                except Exception:  # noqa: BLE001 - manager tearing down
+                    pass
+                telemetry.event("actor/lost", group=self.name, actor=idx,
+                                reason=why, epoch=epoch)
+                logger.warning("actor %s[%d] lost (%s); epoch -> %d",
+                               self.name, idx, why, epoch)
+                if "stale" in why:
+                    # wedged, not dead: kill it so engine supervision
+                    # respawns the slot (process death is the signal the
+                    # engine acts on)
+                    pid = self._table.pids().get(idx)
+                    if pid:
+                        try:
+                            os.kill(pid, signal.SIGKILL)
+                        except OSError:
+                            pass
+            if lost:
+                self._redispatch({idx for idx, _ in lost})
+            # request-timeout sweep: fail asks stuck past the deadline
+            # (None by default: asks wait at the future)
+            timeout = getattr(self.policy, "request_timeout", None)
+            for _key, entry in self._table.stale(timeout):
+                entry["future"].reject(TimeoutError(
+                    f"ask not answered within {timeout}s"))
+
+    def _redispatch(self, dead_idxs):
+        """Re-dispatch asks owned by ``dead_idxs``: to the least-loaded
+        survivor, or — when none is live — re-stamped into the dead
+        member's own mailbox for its respawn (the bumped epoch fences
+        the inherited duplicate)."""
+        moved = 0
+        for req_id in self._table.owned_by(dead_idxs):
+            entry = self._table.get(req_id)
+            if entry is None:
+                continue
+            old = entry["owner"]
+            idx = self._table.reassign(req_id)
+            if idx is None:
+                idx = old
+            with self._epoch_lock:
+                epoch = self._epochs[idx]
+            try:
+                self._inqs[idx].put(
+                    ("ask", epoch, req_id, entry["kind"], entry["blob"]))
+                moved += 1
+            except Exception:  # noqa: BLE001 - manager tearing down
+                pass
+        if moved:
+            telemetry.event("actor/redispatch", group=self.name,
+                            asks=moved, dead=sorted(dead_idxs))
+
+    def _proc_alive(self, idx):
+        procs = getattr(self._system._engine, "_procs", None)
+        slot = self.slots[idx]
+        if procs is None or slot >= len(procs):
+            return True  # foreign engine: no process visibility
+        try:
+            return procs[slot].is_alive()
+        except Exception:  # noqa: BLE001
+            return True
+
+    # -- introspection ---------------------------------------------------------
+    def live(self):
+        return self._table.live()
+
+    def pids(self):
+        return self._table.pids()
+
+    def epochs(self):
+        with self._epoch_lock:
+            return dict(self._epochs)
+
+    def outstanding(self):
+        return len(self._table)
+
+    def rows(self):
+        """Status rows for ``/statusz`` (one per member)."""
+        live = set(self._table.live())
+        pids = self._table.pids()
+        loads = self._table.loads()
+        epochs = self.epochs()
+        out = []
+        for i in range(self.count):
+            age = liveness.beat_age(self._mgr,
+                                    mailbox.beat_key(self.name, i))
+            out.append({
+                "group": self.name, "actor": i,
+                "live": i in live, "pid": pids.get(i),
+                "epoch": epochs.get(i, 0),
+                "in_flight": loads.get(i, 0),
+                "beat_age_s": None if age is None else round(age, 1),
+            })
+        return out
+
+
+class ActorSystem:
+    """Owns the engine slots, the IPC manager and every group spawned
+    through it.  ``capacity`` is the executor-slot count; groups take
+    slots in spawn order."""
+
+    def __init__(self, capacity, engine=None, env=None):
+        if engine is None:
+            from tensorflowonspark_tpu.engine import LocalEngine
+
+            engine = LocalEngine(int(capacity), env=dict(env) if env else None)
+            self._owns_engine = True
+        else:
+            self._owns_engine = False
+        self._engine = engine
+        self.capacity = int(capacity)
+        self._authkey = os.urandom(16)
+        self._mgr = tfmanager.start(self._authkey, [])
+        self._groups = {}
+        self._next_slot = 0
+        self._stopped = False
+        _SYSTEMS.add(self)
+
+    def spawn(self, actor, name, count=1, policy=None, timeout=120.0):
+        """Place ``count`` members of ``actor`` on the next free slots;
+        blocks until all are up.  Returns the :class:`ActorGroup`."""
+        if name in self._groups:
+            raise ValueError(f"actor group {name!r} already exists")
+        count = int(count)
+        if self._next_slot + count > self.capacity:
+            raise ValueError(
+                f"cannot spawn {count} member(s) of {name!r}: "
+                f"{self.capacity - self._next_slot} of {self.capacity} "
+                "slots free")
+        slots = list(range(self._next_slot, self._next_slot + count))
+        self._next_slot += count
+        group = ActorGroup(self, name, actor,
+                           count, policy or SupervisionPolicy(), slots)
+        self._groups[name] = group
+        return group._start(timeout)
+
+    def group(self, name):
+        return self._groups[name]
+
+    def groups(self):
+        return dict(self._groups)
+
+    def stop(self):
+        if self._stopped:
+            return
+        self._stopped = True
+        for group in self._groups.values():
+            group.stop()
+        if self._owns_engine:
+            self._engine.stop()
+        try:
+            self._mgr.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+#: Live systems, for /statusz introspection (obs/http.actor rows).
+_SYSTEMS = weakref.WeakSet()
+
+
+def actor_table():
+    """Status rows for every member of every live :class:`ActorSystem`
+    (the ``/statusz`` actor table)."""
+    rows = []
+    for system in list(_SYSTEMS):
+        if system._stopped:
+            continue
+        for group in system.groups().values():
+            try:
+                rows.extend(group.rows())
+            except Exception:  # noqa: BLE001 - system tearing down
+                continue
+    return sorted(rows, key=lambda r: (r["group"], r["actor"]))

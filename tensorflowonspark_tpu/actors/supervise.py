@@ -1,0 +1,119 @@
+"""Respawn budgets, retry schedules and orphan reaping — THE copy.
+
+Parity anchor: the reference leans on Spark's task-retry machinery
+(``spark.task.maxFailures``; reference ``TFSparkNode.py`` assumes the
+re-run task reattaches by executor id).  This repo's ``LocalEngine``
+reimplemented that supervision inline (budgeted executor respawns,
+jittered-exponential task retries, orphan-child reaping on respawn and
+teardown); this module is those mechanisms extracted so the engine — and
+every other supervisor — is a thin policy layer over them (ISSUE 10
+lint: no bespoke respawn code outside ``actors/``).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["BudgetExhausted", "RespawnBudget", "RetrySchedule",
+           "reap_orphans"]
+
+
+class BudgetExhausted(RuntimeError):
+    """A supervised member died more times than its policy allows."""
+
+
+class RespawnBudget:
+    """Counted permission to replace dead members of a pool.
+
+    ``consume(index)`` either counts one respawn or raises ``error_cls``
+    with the canonical exhaustion message (naming the env knob, so the
+    operator reading the traceback knows what to raise)."""
+
+    __slots__ = ("budget", "used", "what", "env_name", "error_cls")
+
+    def __init__(self, budget, what="executor",
+                 env_name="TFOS_ACTOR_RESPAWNS", error_cls=BudgetExhausted):
+        self.budget = int(budget)
+        self.used = 0
+        self.what = what
+        self.env_name = env_name
+        self.error_cls = error_cls
+
+    def consume(self, index):
+        if self.used >= self.budget:
+            raise self.error_cls(
+                f"{self.what} {index} died and the respawn budget "
+                f"({self.env_name}={self.budget}) is exhausted")
+        self.used += 1
+        return self.used
+
+
+class RetrySchedule:
+    """Per-key retry bookkeeping with jittered exponential backoff.
+
+    Keys are task ids (engine jobs) or actor indices; the schedule keeps
+    every failure reason in arrival order so the permanent error carries
+    the full attempt history."""
+
+    __slots__ = ("max_retries", "backoff", "cap", "attempts", "failures")
+
+    def __init__(self, max_retries, backoff, cap=5.0):
+        self.max_retries = int(max_retries)
+        self.backoff = float(backoff)
+        self.cap = float(cap)
+        self.attempts = {}   # key -> retries consumed
+        self.failures = {}   # key -> [reason], in order
+
+    def record_failure(self, key, reason):
+        self.failures.setdefault(key, []).append(reason)
+
+    def exhausted(self, key):
+        return self.attempts.get(key, 0) >= self.max_retries
+
+    def next_delay(self, key):
+        """Consume one retry; seconds to wait before re-dispatching
+        (exponential in the attempt number, capped, jittered to
+        desynchronize sibling retries)."""
+        a = self.attempts.get(key, 0) + 1
+        self.attempts[key] = a
+        delay = min(self.backoff * (2 ** (a - 1)), self.cap)
+        return delay * (0.5 + random.random())
+
+    def attempt(self, key):
+        return self.attempts.get(key, 0)
+
+    def permanent_error(self, key, subject):
+        """The canonical gave-up message: latest failure first, earlier
+        attempts chained (the engine's poison-task format)."""
+        reasons = self.failures.get(key) or ["(no failure recorded)"]
+        msg = f"{subject}:\n{reasons[-1]}"
+        if len(reasons) > 1:
+            chain = "\n--- earlier attempt ---\n".join(reasons[:-1])
+            msg += (f"\n(permanent after {len(reasons)} attempts; "
+                    f"earlier attempts:\n{chain})")
+        return msg
+
+
+def reap_orphans(dirs, what="child"):
+    """Kill + forget every still-live pid recorded in the given member
+    working dirs (``utils.track_child_pid`` ledger).  A dead member's
+    forked children (IPC-manager server, background trainer) are part of
+    its failure domain: they die before a replacement starts, so a
+    relaunched member never fights a half-dead twin for its identity.
+    Returns the pids killed."""
+    from tensorflowonspark_tpu.utils import (
+        clear_child_pids, kill_pid, read_child_pids,
+    )
+
+    killed = []
+    for d in dirs:
+        for pid in read_child_pids(d):
+            if kill_pid(pid, 0):  # still alive
+                logger.warning("reaping orphaned %s pid %d", what, pid)
+                kill_pid(pid)
+                killed.append(pid)
+        clear_child_pids(d)
+    return killed

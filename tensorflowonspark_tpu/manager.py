@@ -47,14 +47,26 @@ OBS_KEY = "obs:"
 
 
 def heartbeat_interval():
-    return float(os.environ.get("TFOS_HEARTBEAT_SECS", "2"))
+    """Beat cadence (seconds).  ``TFOS_ACTOR_HEARTBEAT_SECS`` is the
+    canonical knob (actors/policy.py env family); the pre-actors name
+    ``TFOS_HEARTBEAT_SECS`` remains a documented alias.  This function
+    is the single chokepoint every liveness producer reads — trainer
+    heartbeat, replica beats, actor beats — so one env retunes all."""
+    return float(os.environ.get(
+        "TFOS_ACTOR_HEARTBEAT_SECS",
+        os.environ.get("TFOS_HEARTBEAT_SECS", "2")))
 
 
 def stale_after():
     """Age (seconds) past which a heartbeat means 'consumer dead'.  The
     default tolerates long GIL-held stretches and first-compile stalls;
-    tune down for fast failure detection in tests."""
-    return float(os.environ.get("TFOS_HEARTBEAT_STALE", "60"))
+    tune down for fast failure detection in tests.
+    ``TFOS_ACTOR_HEARTBEAT_STALE`` is canonical, ``TFOS_HEARTBEAT_STALE``
+    the documented alias; every liveness consumer (replica monitor, data
+    consumer-liveness, actor monitor) reads this one chokepoint."""
+    return float(os.environ.get(
+        "TFOS_ACTOR_HEARTBEAT_STALE",
+        os.environ.get("TFOS_HEARTBEAT_STALE", "60")))
 
 
 def beat(mgr):

@@ -69,6 +69,9 @@ SITES = (
     "rendezvous.register",  # rendezvous.py Client.register
     "rendezvous.query",     # rendezvous.py Client.await_reservations polls
     "checkpoint.save",      # utils/checkpoint.py save paths
+    "actor.spawn",          # actors/runtime.py member boot, before on_start
+    "actor.receive",        # actors/runtime.py, before handling an envelope
+    "actor.tick",           # actors/runtime.py idle tick, before on_tick
 )
 
 #: Sites whose hit counters live in long-lived executor processes, so a

@@ -154,6 +154,20 @@ CATALOG = {
     "tfos_elastic_reshard_ms": (
         "histogram", "Train-state reshard latency (host round-trip), "
                      "milliseconds."),
+    # actor substrate (actors/ — driver process)
+    "tfos_actor_spawns_total": (
+        "counter", "Actor member incarnations registered, by group."),
+    "tfos_actor_respawns_total": (
+        "counter", "Actor members respawned after death, by group."),
+    "tfos_actor_mailbox_depth": (
+        "gauge", "Mailbox depth observed at the last send, by group."),
+    "tfos_actor_heartbeat_age_s": (
+        "gauge", "Oldest live-member heartbeat age, seconds, by group."),
+    # workloads (workloads/ — actor processes)
+    "tfos_eval_runs_total": (
+        "counter", "Eval-sidecar evaluations completed."),
+    "tfos_eval_last_step": (
+        "gauge", "Checkpoint step of the last completed evaluation."),
 }
 
 

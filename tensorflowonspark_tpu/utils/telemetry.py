@@ -77,6 +77,8 @@ SERVE_BATCH = "serve/replica_batch"   # replica-side device batch span
 SERVE_RELOAD = "serve/reload"         # hot-reload broadcast event
 DECODE_SESSION = "decode/session"     # one autoregressive decode session
 DECODE_SHED = "decode/shed"           # decode admission-control rejection
+ACTOR_MESSAGE = "actor/message"       # one actor envelope handled
+EVAL_RUN = "eval/run"                 # one eval-sidecar evaluation
 
 
 class Recorder:

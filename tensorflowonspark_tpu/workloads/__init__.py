@@ -1,0 +1,14 @@
+"""Workloads composed purely from the actor substrate (``actors/``).
+
+Each module here is an existence proof of ISSUE 10's claim: new
+supervised behaviors are actor definitions + policy, with zero bespoke
+supervision/respawn/ledger code (the lint test in tests/test_actors.py
+enforces this for everything outside ``actors/``).
+"""
+
+from tensorflowonspark_tpu.workloads.eval_sidecar import (  # noqa: F401
+    EvalSidecar,
+)
+from tensorflowonspark_tpu.workloads.sweep import (  # noqa: F401
+    TrialActor, successive_halving,
+)

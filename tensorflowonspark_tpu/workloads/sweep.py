@@ -1,0 +1,123 @@
+"""Successive-halving hyperparameter sweep as pure actors.
+
+Parity anchor: ``parallel_run.py`` (reference ``TFParallel.py:26-68`` —
+one barrier wave of independent instances, all run to completion).  This
+module extends that barrier parity with EARLY STOPPING: configs compete
+in rungs; after each rung only the top ``1/eta`` survive and the budget
+multiplies by ``eta`` (successive halving, the Hyperband inner loop) —
+so total work is ~``n * budget * log_eta(n)`` instead of every config
+running at full budget.  ROADMAP item 5's named scenario.
+
+Like the eval sidecar, this carries ZERO supervision/respawn/ledger code
+(the lint test enforces it): trials run as ``ask``s to an
+:class:`~tensorflowonspark_tpu.actors.ActorGroup` of
+:class:`TrialActor`s, so a worker SIGKILLed mid-trial is respawned by
+the substrate and its trial re-dispatched, with the resolve-once ask
+future absorbing any duplicate answer.  Each rung is a barrier — every
+surviving config's future resolves before ranking — matching
+``parallel_run``'s collect(spread=True) semantics.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+
+from tensorflowonspark_tpu.actors import Actor
+from tensorflowonspark_tpu.utils import telemetry
+
+logger = logging.getLogger(__name__)
+
+
+class TrialActor(Actor):
+    """Runs one trial per ``ask``: ``trial_fn(config, budget) -> score``
+    (higher is better).  State-free between trials by design — any
+    member can run any trial, so failover needs no affinity."""
+
+    def __init__(self, trial_fn):
+        self.trial_fn = trial_fn
+
+    def on_message(self, ctx, kind, payload):
+        if kind != "trial":
+            raise NotImplementedError(f"unhandled message kind {kind!r}")
+        score = self.trial_fn(payload["config"], payload["budget"])
+        return {"trial": payload["trial"], "score": float(score),
+                "budget": payload["budget"]}
+
+
+def successive_halving(trial_fn, configs, budget=1, eta=2, workers=None,
+                       system=None, policy=None, env=None, target=None,
+                       timeout=600.0, name="sweep"):
+    """Run a successive-halving sweep over ``configs``.
+
+    Args:
+      trial_fn: ``(config, budget) -> score`` (higher is better); must
+        be module-importable in workers (spawn start method) and
+        idempotent per (config, budget) — a failover re-runs it.
+      configs: list of config objects (anything picklable).
+      budget: rung-0 budget passed to ``trial_fn`` (epochs, steps...).
+      eta: halving rate — keep ``ceil(n/eta)`` per rung, multiply the
+        budget by ``eta``.
+      workers: trial actors to spawn (default ``min(len(configs), 4)``).
+      system: an existing :class:`~tensorflowonspark_tpu.actors.ActorSystem`
+        to spawn into (a fresh one is created and stopped otherwise).
+      policy: optional SupervisionPolicy for the trial group.
+      env: env overrides for a freshly-created system's executors.
+      target: optional early-stop score — the sweep returns as soon as a
+        rung's best reaches it.
+      timeout: per-rung wait for all trial replies.
+      name: actor-group name (unique per system).
+
+    Returns ``{"best": {"trial", "config", "score", "budget"},
+    "history": [per-rung dicts]}``.
+    """
+    configs = list(configs)
+    if not configs:
+        raise ValueError("successive_halving needs at least one config")
+    workers = int(workers or min(len(configs), 4))
+    own_system = system is None
+    if own_system:
+        from tensorflowonspark_tpu.actors import ActorSystem
+
+        system = ActorSystem(workers, env=env)
+    try:
+        group = system.spawn(TrialActor(trial_fn), name, count=workers,
+                             policy=policy)
+        survivors = list(enumerate(configs))   # (trial id, config)
+        history = []
+        best = None
+        rung = 0
+        while survivors:
+            futures = [(tid, cfg,
+                        group.ask("trial", {"trial": tid, "config": cfg,
+                                            "budget": budget}))
+                       for tid, cfg in survivors]
+            # rung barrier: every surviving config resolves before
+            # ranking (parallel_run collect(spread=True) parity)
+            results = [(tid, cfg, f.result(timeout))
+                       for tid, cfg, f in futures]
+            results.sort(key=lambda r: (-r[2]["score"], r[0]))
+            history.append({
+                "rung": rung, "budget": budget,
+                "scores": {tid: r["score"] for tid, _cfg, r in results},
+            })
+            tid, cfg, r = results[0]
+            best = {"trial": tid, "config": cfg, "score": r["score"],
+                    "budget": budget}
+            telemetry.event("sweep/rung", rung=rung, budget=budget,
+                            survivors=len(results),
+                            best_trial=tid, best_score=r["score"])
+            if target is not None and best["score"] >= target:
+                logger.info("sweep: target %.4g reached at rung %d by "
+                            "trial %d", target, rung, tid)
+                break
+            if len(results) == 1:
+                break
+            keep = max(1, math.ceil(len(results) / eta))
+            survivors = [(t, c) for t, c, _r in results[:keep]]
+            budget *= eta
+            rung += 1
+        return {"best": best, "history": history}
+    finally:
+        if own_system:
+            system.stop()
