@@ -145,6 +145,7 @@ def summarize(pairs, skipped=0):
     serve = {"totals_ms": [], "queue_ms": [], "device_ms": [],
              "batches": [], "shed": 0}
     data_stages = {}
+    actors = {"msgs": {}, "respawns": 0, "lost": 0, "redispatched": 0}
     for rec in recs:
         node = per_node.setdefault(
             rec["node_id"],
@@ -153,6 +154,13 @@ def summarize(pairs, skipped=0):
         )
         if rec["name"] == "serve/shed":
             serve["shed"] += 1
+        elif rec["name"] == "actor/respawn":
+            actors["respawns"] += 1
+        elif rec["name"] == "actor/lost":
+            actors["lost"] += 1
+        elif rec["name"] == "actor/redispatch":
+            actors["redispatched"] += int(
+                (rec["attrs"] or {}).get("asks") or 0)
         if rec["kind"] != "span" or rec["dur_ms"] is None:
             continue
         ph = phases.setdefault(rec["name"], {"count": 0, "total_ms": 0.0,
@@ -178,6 +186,10 @@ def summarize(pairs, skipped=0):
             st["self_ms"].append(float(rec["dur_ms"]))
             st["wait_ms"].append(float(attrs.get("wait_ms") or 0.0))
             st["records"] += int(attrs.get("records") or 0)
+        elif rec["name"] == "actor/message":
+            key = (str(attrs.get("group") or "?"),
+                   str(attrs.get("kind") or "?"))
+            actors["msgs"].setdefault(key, []).append(float(rec["dur_ms"]))
         elif rec["name"] == "serve/request":
             serve["totals_ms"].append(float(rec["dur_ms"]))
             if attrs.get("queue_ms") is not None:
@@ -237,6 +249,34 @@ def summarize(pairs, skipped=0):
             f"mean queue={s['mean_queue_ms']:.1f}ms "
             f"device={s['mean_device_ms']:.1f}ms "
             f"device batch={s['mean_device_batch']:.1f}")
+
+    if actors["msgs"] or actors["respawns"] or actors["lost"]:
+        # supervised-actor health (docs/actors.md): per-message handler
+        # latency by (group, kind); lost/respawn/redispatch counts are
+        # the failover story of the run
+        stats["actors"] = {
+            "respawns": actors["respawns"],
+            "lost": actors["lost"],
+            "redispatched_asks": actors["redispatched"],
+            "messages": {},
+        }
+        lines.append("")
+        lines.append("-- actors (actor/message spans) --")
+        lines.append(
+            f"lost={actors['lost']} respawns={actors['respawns']} "
+            f"redispatched_asks={actors['redispatched']}")
+        if actors["msgs"]:
+            lines.append(f"{'group':<16} {'kind':<16} {'count':>7} "
+                         f"{'p50_ms':>9} {'p95_ms':>9} {'max_ms':>9}")
+        for (group, kind), durs in sorted(actors["msgs"].items()):
+            durs = sorted(durs)
+            row = {"count": len(durs), "p50_ms": _pct(durs, 0.50),
+                   "p95_ms": _pct(durs, 0.95), "max_ms": durs[-1]}
+            stats["actors"]["messages"][f"{group}:{kind}"] = row
+            lines.append(
+                f"{group:<16} {kind:<16} {row['count']:>7} "
+                f"{row['p50_ms']:>9.2f} {row['p95_ms']:>9.2f} "
+                f"{row['max_ms']:>9.2f}")
 
     if data_stages:
         # input-pipeline stall attribution (docs/data.md): each

@@ -148,6 +148,12 @@ class InFlightTable:
                     out.append((key, entry))
         return out
 
+    def keys(self):
+        """All in-flight keys, insertion-ordered (introspection: callers
+        count per-namespace, e.g. outstanding decode sessions)."""
+        with self._lock:
+            return list(self._entries)
+
     def drain(self):
         """Pop everything (pool teardown fails all outstanding work)."""
         with self._lock:

@@ -44,6 +44,9 @@ import os
 import time
 
 from tensorflowonspark_tpu import rendezvous
+from tensorflowonspark_tpu.actors.ledger import (
+    NullLedgerClient, resume_cursor,
+)
 from tensorflowonspark_tpu.obs import publish as obs_publish
 from tensorflowonspark_tpu.utils import faults, metrics_registry, telemetry
 
@@ -139,9 +142,7 @@ class DataService:
             consumed = client.fed_partitions(ledger_feed(self.qname, st.rank))
         except Exception as e:  # noqa: BLE001 - no ledger in standalone use
             logger.debug("data worker: no feed ledger (%s)", e)
-        done = set(consumed)
-        while st.unit in done:
-            st.unit += 1
+        st.unit = resume_cursor(consumed, start=st.unit)
         skip = st.unit * self.unit_blocks
         if skip:
             logger.info(
@@ -296,18 +297,9 @@ class DataService:
         return summary
 
 
-class _NullClient:
-    """Ledger stand-in when no rendezvous server is reachable
-    (standalone DataService use in tests/benches)."""
-
-    def fed_partitions(self, feed):
-        return []
-
-    def partition_done(self, feed, part):
-        pass
-
-    def close(self):
-        pass
+# Ledger stand-in when no rendezvous server is reachable (standalone
+# DataService use in tests/benches) — the shared actors copy.
+_NullClient = NullLedgerClient
 
 
 def default_workers():

@@ -39,7 +39,6 @@ import contextlib
 import logging
 import os
 import queue as _queue
-import random
 import shutil
 import tempfile
 import threading
@@ -49,6 +48,7 @@ import multiprocessing as mp
 
 import cloudpickle
 
+from tensorflowonspark_tpu.actors import supervise as _supervise
 from tensorflowonspark_tpu.utils import faults, metrics_registry, telemetry
 
 logger = logging.getLogger(__name__)
@@ -346,11 +346,14 @@ class LocalEngine:
         self._job_queues = {}  # job_id -> local queue (results demux)
         self._cancelled = False
         self.executor_dirs = []
-        # supervision knobs (foreach_partition(retryable=True) path)
+        # supervision knobs (foreach_partition(retryable=True) path);
+        # the mechanisms live in actors.supervise — the engine is policy
         self._max_retries = int(os.environ.get("TFOS_TASK_RETRIES", "2"))
         self._retry_backoff = float(os.environ.get("TFOS_RETRY_BACKOFF", "0.25"))
-        self._respawn_budget = int(os.environ.get("TFOS_EXECUTOR_RESPAWNS", "8"))
-        self._respawns = 0
+        self._budget = _supervise.RespawnBudget(
+            int(os.environ.get("TFOS_EXECUTOR_RESPAWNS", "8")),
+            what="executor", env_name="TFOS_EXECUTOR_RESPAWNS",
+            error_cls=TaskError)
         self._retired = set()  # slots removed by an elastic cluster shrink
         self._spawn_lock = threading.Lock()
         with _patched_env(self._env):
@@ -390,18 +393,19 @@ class LocalEngine:
         return p
 
     # -- supervision ----------------------------------------------------------
+    @property
+    def _respawns(self):
+        """Respawns consumed so far (budget bookkeeping in supervise)."""
+        return self._budget.used
+
     def _respawn_executor(self, index):
         """Replace a dead executor process; True if a respawn happened.
 
         The dead incarnation's forked children (IPC-manager server,
-        background trainer) are part of its failure domain: they are
-        killed via the executor dir's pid file before the replacement
-        starts, so a relaunched node never fights a half-dead twin for
-        the executor's identity."""
-        from tensorflowonspark_tpu.utils import (
-            clear_child_pids, kill_pid, read_child_pids,
-        )
-
+        background trainer) are part of its failure domain:
+        ``supervise.reap_orphans`` kills them via the executor dir's pid
+        file before the replacement starts, so a relaunched node never
+        fights a half-dead twin for the executor's identity."""
         with self._spawn_lock:
             if self._procs[index].is_alive():
                 return False
@@ -409,31 +413,20 @@ class LocalEngine:
                 raise TaskError(
                     f"executor {index} is retired (elastic cluster shrink); "
                     "its slot is no longer part of the dispatch pool")
-            if self._respawns >= self._respawn_budget:
-                raise TaskError(
-                    f"executor {index} died and the respawn budget "
-                    f"(TFOS_EXECUTOR_RESPAWNS={self._respawn_budget}) is "
-                    "exhausted")
-            self._respawns += 1
-            d = self.executor_dirs[index]
-            for pid in read_child_pids(d):
-                if kill_pid(pid, 0):  # still alive
-                    logger.warning(
-                        "respawn: killing orphaned child pid %d of dead "
-                        "executor %d", pid, index)
-                    kill_pid(pid)
-            clear_child_pids(d)
+            self._budget.consume(index)
+            _supervise.reap_orphans([self.executor_dirs[index]],
+                                    what=f"child of dead executor {index}")
             with _patched_env(self._env):
                 self._procs[index] = self._spawn_executor(index)
         telemetry.event("engine/executor_respawn", executor=index,
-                        respawns=self._respawns)
+                        respawns=self._budget.used)
         metrics_registry.inc("tfos_engine_respawns_total")
         if metrics_registry.enabled():
             metrics_registry.set_gauge(
                 "tfos_engine_executors",
                 sum(1 for p in self._procs if p.is_alive()))
         logger.warning("respawned executor %d (%d/%d respawns used)",
-                       index, self._respawns, self._respawn_budget)
+                       index, self._budget.used, self._budget.budget)
         return True
 
     def ensure_executors(self):
@@ -590,41 +583,33 @@ class LocalEngine:
 
         results = [None] * ntasks
         done = [False] * ntasks
-        attempts = [0] * ntasks       # retries consumed per task
-        failures = [[] for _ in range(ntasks)]  # remote tracebacks, in order
+        sched = _supervise.RetrySchedule(max_retries, self._retry_backoff)
         running = {}                  # task_id -> executor (start-acked)
         retry_at = {}                 # task_id -> monotonic re-dispatch time
         ndone = 0
 
-        def _fail_permanently(tid):
-            msg = f"task {tid} failed on executor:\n{failures[tid][-1]}"
-            if len(failures[tid]) > 1:
-                chain = "\n--- earlier attempt ---\n".join(failures[tid][:-1])
-                msg += (f"\n(permanent after {len(failures[tid])} attempts; "
-                        f"earlier attempts:\n{chain})")
-            raise TaskError(msg)
-
         def _schedule_retry(tid, reason):
             """Count a failed attempt; queue a backoff re-dispatch or fail
             the job once the budget is spent (poison task)."""
-            failures[tid].append(reason)
+            sched.record_failure(tid, reason)
             metrics_registry.inc("tfos_engine_tasks_total", status="error")
             running.pop(tid, None)
-            if attempts[tid] >= max_retries:
+            if sched.exhausted(tid):
                 if retryable:
                     telemetry.event("engine/task_poison", job=job_id,
-                                    task=tid, attempts=attempts[tid] + 1)
-                _fail_permanently(tid)
-            attempts[tid] += 1
-            delay = min(self._retry_backoff * (2 ** (attempts[tid] - 1)), 5.0)
-            delay *= 0.5 + random.random()  # jitter: desynchronize retries
+                                    task=tid, attempts=sched.attempt(tid) + 1)
+                raise TaskError(sched.permanent_error(
+                    tid, f"task {tid} failed on executor"))
+            delay = sched.next_delay(tid)
             retry_at[tid] = time.monotonic() + delay
             telemetry.event("engine/task_retry", job=job_id, task=tid,
-                            attempt=attempts[tid], delay_ms=int(delay * 1000))
+                            attempt=sched.attempt(tid),
+                            delay_ms=int(delay * 1000))
             metrics_registry.inc("tfos_engine_task_retries_total")
             logger.warning(
                 "task %d of job %d failed (attempt %d of %d); retrying "
-                "in %.2fs", tid, job_id, attempts[tid], max_retries + 1, delay)
+                "in %.2fs", tid, job_id, sched.attempt(tid),
+                max_retries + 1, delay)
 
         try:
             for task_id in range(ntasks):
@@ -670,11 +655,9 @@ class LocalEngine:
                 if done[tid]:
                     continue  # late duplicate from a superseded attempt
                 if status == "error":
-                    if max_retries == 0:
-                        failures[tid].append(payload)
-                        metrics_registry.inc("tfos_engine_tasks_total",
-                                             status="error")
-                        _fail_permanently(tid)
+                    # max_retries == 0 (non-retryable jobs) is exhausted on
+                    # the first failure, so this fails fast with the same
+                    # single-attempt message as before
                     _schedule_retry(tid, payload)
                     continue
                 # status == "ok"; payloads are serialized child-side
@@ -726,19 +709,10 @@ class LocalEngine:
         # (background trainer, IPC-manager server) re-parented to init;
         # each executor recorded those pids in its working dir — kill any
         # survivor so nothing outlives the engine (and nothing keeps the
-        # resource-tracker pipe open past interpreter exit).
-        from tensorflowonspark_tpu.utils import (
-            clear_child_pids, kill_pid, read_child_pids,
-        )
-
-        for d in self.executor_dirs:
-            for pid in read_child_pids(d):
-                if kill_pid(pid, 0):  # still alive
-                    logger.warning("stop: killing leftover child pid %d", pid)
-                    kill_pid(pid)
-            # the ledger is spent once its pids are swept: clean it so a
-            # caller-provided workdir isn't left with pid droppings
-            clear_child_pids(d)
+        # resource-tracker pipe open past interpreter exit).  The pid
+        # ledger is cleared once swept, so a caller-provided workdir is
+        # not left with pid droppings.
+        _supervise.reap_orphans(self.executor_dirs, what="leftover child")
         if self._owns_root:
             shutil.rmtree(self._root, ignore_errors=True)
 

@@ -320,6 +320,17 @@ class ObsServer:
                 "summary": node_summary(driver),
             }
         out["nodes"] = nodes
+        # Supervised-actor table: one row per member of every live
+        # ActorSystem in this process (lazy import: obs has no actor
+        # dependency unless someone spawned one).
+        try:
+            from tensorflowonspark_tpu.actors.runtime import actor_table
+
+            rows = actor_table()
+        except Exception:  # noqa: BLE001 - actors tearing down
+            rows = []
+        if rows:
+            out["actors"] = rows
         return out
 
 

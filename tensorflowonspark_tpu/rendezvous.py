@@ -30,6 +30,7 @@ import struct
 import threading
 import time
 
+from tensorflowonspark_tpu.actors.ledger import DeliveryLedger
 from tensorflowonspark_tpu.utils import faults, telemetry
 
 logger = logging.getLogger(__name__)
@@ -167,8 +168,7 @@ class Server(MessageSocket):
         # Feed-replay ledger: feeders report fully-consumed partitions
         # (PDONE) per feed qname; after a recovery the driver re-feeds
         # only what is NOT in the ledger.
-        self._feeds = {}
-        self._feed_lock = threading.Lock()
+        self._feeds = DeliveryLedger()
 
     def reset(self, epoch):
         """Fence a new cluster incarnation: drop all reservations and the
@@ -195,14 +195,12 @@ class Server(MessageSocket):
 
     def fed_partitions(self, feed="input"):
         """Sorted partition indices recorded as fully consumed for ``feed``."""
-        with self._feed_lock:
-            return sorted(self._feeds.get(str(feed), ()))
+        return self._feeds.done_units(feed)
 
     def reset_feed(self, feed="input"):
         """Clear the consumption ledger for ``feed`` (start of a train
         call: each train() owns one replay scope)."""
-        with self._feed_lock:
-            self._feeds.pop(str(feed), None)
+        self._feeds.reset(feed)
 
     def start(self):
         """Bind, spawn the select() loop thread, return (host, port)."""
@@ -281,10 +279,7 @@ class Server(MessageSocket):
             self.reservations.add(msg["data"])
             self.send(sock, {"type": "OK"})
         elif kind == "PDONE":
-            with self._feed_lock:
-                self._feeds.setdefault(
-                    str(msg.get("feed", "input")), set()
-                ).add(int(msg["part"]))
+            self._feeds.record(msg.get("feed", "input"), int(msg["part"]))
             self.send(sock, {"type": "OK"})
         elif kind == "PQUERY":
             self.send(sock, {

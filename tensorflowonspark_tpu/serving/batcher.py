@@ -38,6 +38,7 @@ import time
 
 import numpy as np
 
+from tensorflowonspark_tpu.actors.ledger import OnceGate, ResolveOnce
 from tensorflowonspark_tpu.utils import metrics_registry
 
 logger = logging.getLogger(__name__)
@@ -161,44 +162,31 @@ class Overloaded(RuntimeError):
         self.retry_after = retry_after
 
 
-class PendingResult:
-    """One request's future: resolved by the batch that absorbed it."""
+class PendingResult(ResolveOnce):
+    """One request's future: resolved by the batch that absorbed it.
+    Resolve-once semantics come from ``actors.ledger.ResolveOnce`` —
+    the first complete()/fail() of any batch attempt wins."""
 
-    __slots__ = ("example", "attrs", "t_submit", "_event", "_value",
-                 "_error")
+    __slots__ = ("example", "attrs", "t_submit")
 
     def __init__(self, example):
+        super().__init__()
         self.example = example
         self.attrs = None            # timing attrs, set on resolve
         self.t_submit = time.perf_counter()
-        self._event = threading.Event()
-        self._value = None
-        self._error = None
-
-    def done(self):
-        return self._event.is_set()
 
     def result(self, timeout=None):
         """Block for the outputs row ({tensor_name: ndarray}); raises the
         batch's error, or TimeoutError after ``timeout`` seconds."""
         timeout = request_timeout_default() if timeout is None else timeout
-        if not self._event.wait(timeout):
-            raise TimeoutError(f"request not served within {timeout}s")
-        if self._error is not None:
-            raise self._error
-        return self._value
+        return self.wait(timeout, "request not served")
 
-    # resolve-once: the first complete()/fail() of any batch attempt wins
     def _set(self, value, attrs):
-        if not self._event.is_set():
-            self._value = value
-            self.attrs = attrs
-            self._event.set()
+        self.attrs = attrs
+        self.resolve(value)
 
     def _fail(self, exc):
-        if not self._event.is_set():
-            self._error = exc
-            self._event.set()
+        self.reject(exc)
 
 
 class Batch:
@@ -220,15 +208,10 @@ class Batch:
         self.t_assembled = time.perf_counter()
         self._observer = observer
         self._batch_observer = batch_observer
-        self._resolved = False
-        self._lock = threading.Lock()
+        self._gate = OnceGate()
 
     def _claim(self):
-        with self._lock:
-            if self._resolved:
-                return False
-            self._resolved = True
-            return True
+        return self._gate.claim()
 
     def complete(self, outputs, meta=None):
         """Resolve every request with its row of ``outputs`` (padded rows

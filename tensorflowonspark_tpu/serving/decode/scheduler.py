@@ -38,6 +38,7 @@ import time
 
 import numpy as np
 
+from tensorflowonspark_tpu.actors.ledger import IndexLedger, ResolveOnce
 from tensorflowonspark_tpu.serving import batcher as _batcher
 from tensorflowonspark_tpu.utils import metrics_registry
 
@@ -77,40 +78,33 @@ class DecodeSpec:
         self.max_tokens = int(max_tokens or max_tokens_default())
 
 
-class PendingSession:
+class PendingSession(ResolveOnce):
     """One decode session's future: a streaming token ledger plus the
-    resolve-once result, mirroring ``batcher.PendingResult``.
+    resolve-once result, mirroring ``batcher.PendingResult``.  Both
+    pieces come from ``actors.ledger``.
 
-    The ledger keys on token INDEX: after a replica SIGKILL the session
-    re-prefills on a survivor and greedy decode re-streams the same
-    ``(index, token)`` pairs — the first arrival of an index wins (its
-    timestamp included, so TTFT/per-token stats survive failover), and
-    a duplicate ``gen_done`` is swallowed by the resolve-once gate.
+    The :class:`~tensorflowonspark_tpu.actors.ledger.IndexLedger` keys
+    on token INDEX: after a replica SIGKILL the session re-prefills on a
+    survivor and greedy decode re-streams the same ``(index, token)``
+    pairs — the first arrival of an index wins (its timestamp included,
+    so TTFT/per-token stats survive failover), and a duplicate
+    ``gen_done`` is swallowed by the resolve-once gate.
     """
 
     __slots__ = ("id", "prompt", "max_tokens", "eos_id", "t_submit",
-                 "_tokens", "_t_arrive", "_event", "_value", "_error",
-                 "_lock")
+                 "_ledger")
 
     def __init__(self, sid, prompt, max_tokens, eos_id):
+        super().__init__()
         self.id = sid
         self.prompt = [int(t) for t in prompt]
         self.max_tokens = int(max_tokens)
         self.eos_id = eos_id
         self.t_submit = time.perf_counter()
-        self._tokens = {}           # index -> token (first arrival wins)
-        self._t_arrive = {}         # index -> perf_counter of first arrival
-        self._event = threading.Event()
-        self._value = None
-        self._error = None
-        self._lock = threading.Lock()
-
-    def done(self):
-        return self._event.is_set()
+        self._ledger = IndexLedger()   # index -> token, first arrival wins
 
     def tokens_so_far(self):
-        with self._lock:
-            return [self._tokens[i] for i in sorted(self._tokens)]
+        return [int(t) for t in self._ledger.values()]
 
     def result(self, timeout=None):
         """Block for the session result dict (``tokens``, ``ttft_ms``,
@@ -118,45 +112,33 @@ class PendingSession:
         session's error or TimeoutError."""
         timeout = (_batcher.request_timeout_default()
                    if timeout is None else timeout)
-        if not self._event.wait(timeout):
-            raise TimeoutError(f"decode session not done within {timeout}s")
-        if self._error is not None:
-            raise self._error
-        return self._value
+        return self.wait(timeout, "decode session not done")
 
     # -- resolve-once plumbing (pool._collect calls these) ------------------
     def _token(self, index, token):
-        with self._lock:
-            if index not in self._tokens:
-                self._tokens[index] = int(token)
-                self._t_arrive[index] = time.perf_counter()
+        self._ledger.record(index, int(token))
 
     def _set(self, tokens, meta):
-        with self._lock:
-            if self._event.is_set():
-                return
-            now = time.perf_counter()
-            t0 = self._t_arrive.get(0)
-            gaps = []
-            order = sorted(self._t_arrive)
-            for a, b in zip(order, order[1:]):
-                if b == a + 1:  # only adjacent indices time a real gap
-                    gaps.append((self._t_arrive[b] - self._t_arrive[a]) * 1e3)
-            self._value = {
-                "tokens": [int(t) for t in tokens],
-                "ttft_ms": (round((t0 - self.t_submit) * 1e3, 3)
-                            if t0 is not None else None),
-                "token_ms": [round(g, 3) for g in gaps],
-                "total_ms": round((now - self.t_submit) * 1e3, 3),
-                **(meta or {}),
-            }
-            self._event.set()
+        if self.done():
+            return
+        now = time.perf_counter()
+        times = self._ledger.times()
+        gaps = []
+        order = sorted(times)
+        for a, b in zip(order, order[1:]):
+            if b == a + 1:  # only adjacent indices time a real gap
+                gaps.append((times[b] - times[a]) * 1e3)
+        self.resolve({
+            "tokens": [int(t) for t in tokens],
+            "ttft_ms": (round((times[0] - self.t_submit) * 1e3, 3)
+                        if 0 in times else None),
+            "token_ms": [round(g, 3) for g in gaps],
+            "total_ms": round((now - self.t_submit) * 1e3, 3),
+            **(meta or {}),
+        })
 
     def _fail(self, exc):
-        with self._lock:
-            if not self._event.is_set():
-                self._error = exc
-                self._event.set()
+        self.reject(exc)
 
 
 class _Slot:

@@ -11,14 +11,16 @@ runtime instead of reinvented:
 - request/response transport is the executor IPC manager
   (manager.TFManager named queues — the DataFeed transport of
   reference TFSparkNode.py:480-482, batched);
-- liveness is the manager KV heartbeat (manager.beat/heartbeat_age)
-  plus direct executor-process checks, the same two signals
-  engine/node supervision uses.
+- liveness is the keyed manager-KV heartbeat (``actors.liveness``) plus
+  direct executor-process checks, the same two signals engine/node and
+  actor supervision use.
 
 Dispatch is least-loaded among live replicas (round-robin when idle —
-ties broken by index).  In-flight batches of a dead replica are
-re-dispatched to survivors; `batcher.Batch` resolves once, so a
-duplicate answer from a half-dead replica is a no-op.
+ties broken by index), via the shared ``actors.dispatch.InFlightTable``
+(one table, keys namespaced ``("batch", id)`` / ``("gen", sid)``).
+In-flight batches of a dead replica are re-dispatched to survivors;
+`batcher.Batch` resolves once, so a duplicate answer from a half-dead
+replica is a no-op.
 
 Checkpoint hot-reload: when the spec names a ``ckpt_dir``, a watcher
 thread polls ``utils/checkpoint.latest`` every
@@ -40,6 +42,8 @@ import cloudpickle
 import numpy as np
 
 from tensorflowonspark_tpu import manager as tfmanager
+from tensorflowonspark_tpu.actors import liveness
+from tensorflowonspark_tpu.actors.dispatch import InFlightTable
 from tensorflowonspark_tpu.utils import metrics_registry, telemetry
 
 logger = logging.getLogger(__name__)
@@ -289,20 +293,10 @@ def _make_replica_task(payload_blob, mgr_addr, mgr_authkey):
         except BaseException as e:  # noqa: BLE001 - report, then fail task
             outq.put(("init_error", idx, repr(e)))
             raise
-        # manager-KV heartbeat (manager.beat contract): the pool reads
+        # keyed manager-KV heartbeat (actors.liveness): the pool reads
         # its age to tell a wedged replica from a slow one
-        stop_beat = threading.Event()
-
-        def _beat():
-            while not stop_beat.is_set():
-                try:
-                    mgr.set(HEARTBEAT_PREFIX + str(idx), time.time())
-                except Exception:  # noqa: BLE001 - manager tearing down
-                    return
-                stop_beat.wait(tfmanager.heartbeat_interval())
-
-        threading.Thread(target=_beat, name="tfos-serve-beat",
-                         daemon=True).start()
+        stop_beat = liveness.start_heartbeat(
+            mgr, HEARTBEAT_PREFIX + str(idx))
         outq.put(("up", idx, os.getpid(), pred.version))
         try:
             while True:
@@ -387,12 +381,11 @@ class ReplicaPool:
         self._mgr = None
         self._inqs = {}
         self._lock = threading.Lock()
-        self._live = set()           # replica idx with an active loop
-        self._pids = {}              # idx -> os pid (latest incarnation)
+        # membership, loads and the in-flight batch/session entries all
+        # live in the shared dispatch table (actors.dispatch); keys are
+        # namespaced ("batch", id) / ("gen", sid)
+        self._table = InFlightTable(self.num_replicas)
         self._versions = {}          # idx -> last acked params version
-        self._inflight = {}          # batch_id -> entry dict
-        self._sessions = {}          # session id -> decode session entry
-        self._loads = {}             # idx -> in-flight batch count
         self._stats_replies = {}
         self._stats_event = threading.Event()
         self._registered = threading.Event()
@@ -444,29 +437,24 @@ class ReplicaPool:
             if self._job_error is not None:
                 raise RuntimeError(
                     f"replica pool failed to start: {self._job_error}")
-            with self._lock:
-                if len(self._live) >= self.num_replicas:
-                    return self
+            if len(self._table.live()) >= self.num_replicas:
+                return self
             self._registered.wait(0.2)
             self._registered.clear()
         raise TimeoutError(
             f"replicas not up within {timeout}s "
-            f"({len(self._live)}/{self.num_replicas})")
+            f"({len(self._table.live())}/{self.num_replicas})")
 
     def stop(self):
         if self._stop.is_set():
             return
         self._stop.set()
         err = RuntimeError("replica pool stopped")
-        with self._lock:
-            entries = list(self._inflight.values())
-            self._inflight.clear()
-            sessions = list(self._sessions.values())
-            self._sessions.clear()
-        for entry in entries:
-            entry["batch"].fail(err)
-        for entry in sessions:
-            entry["session"]._fail(err)
+        for key, entry in self._table.drain():
+            if key[0] == "batch":
+                entry["batch"].fail(err)
+            else:
+                entry["session"]._fail(err)
         for inq in self._inqs.values():
             try:
                 inq.put(("stop",))
@@ -487,17 +475,12 @@ class ReplicaPool:
     def dispatch(self, batch):
         """Send one batcher Batch to the least-loaded live replica.
         Called from the batcher thread; must not block on the device."""
-        if self._job_error is not None and not self._live:
+        if self._job_error is not None and not self._table.live():
             raise RuntimeError(
                 f"no replicas left (job failed: {self._job_error})")
         blob = cloudpickle.dumps((batch.inputs, batch.n_valid))
-        with self._lock:
-            idx = self._pick_replica_locked()
-            self._inflight[batch.id] = {
-                "batch": batch, "blob": blob, "replica": idx,
-                "t": time.monotonic(),
-            }
-            self._loads[idx] = self._loads.get(idx, 0) + 1
+        idx = self._table.add(("batch", batch.id),
+                              {"batch": batch, "blob": blob})
         self._inqs[idx].put(("batch", batch.id, blob))
 
     def dispatch_session(self, session):
@@ -510,7 +493,7 @@ class ReplicaPool:
         if self.spec.decode is None:
             raise RuntimeError("spec has no decode engine; pass "
                                "ModelSpec(..., decode=DecodeSpec(...))")
-        if self._job_error is not None and not self._live:
+        if self._job_error is not None and not self._table.live():
             raise RuntimeError(
                 f"no replicas left (job failed: {self._job_error})")
         blob = cloudpickle.dumps({
@@ -518,32 +501,17 @@ class ReplicaPool:
             "max_tokens": session.max_tokens,
             "eos_id": session.eos_id,
         })
-        with self._lock:
-            idx = self._pick_replica_locked()
-            self._sessions[session.id] = {
-                "session": session, "blob": blob, "replica": idx,
-                "t": time.monotonic(),
-            }
-            self._loads[idx] = self._loads.get(idx, 0) + 1
+        idx = self._table.add(("gen", session.id),
+                              {"session": session, "blob": blob})
         self._inqs[idx].put(("gen", session.id, blob))
 
     def cancel_session(self, sid):
         """Forget a session (client gave up): its slot keeps generating
         replica-side, but late answers find no entry and are dropped."""
-        with self._lock:
-            entry = self._sessions.pop(sid, None)
-            if entry is not None:
-                i = entry["replica"]
-                self._loads[i] = max(0, self._loads.get(i, 1) - 1)
-        return entry is not None
+        return self._table.pop(("gen", sid)) is not None
 
     def outstanding_sessions(self):
-        with self._lock:
-            return len(self._sessions)
-
-    def _pick_replica_locked(self):
-        candidates = sorted(self._live) or list(range(self.num_replicas))
-        return min(candidates, key=lambda i: (self._loads.get(i, 0), i))
+        return sum(1 for k in self._table.keys() if k[0] == "gen")
 
     # -- background threads ----------------------------------------------------
     def _collect(self):
@@ -558,15 +526,10 @@ class ReplicaPool:
             kind = msg[0]
             if kind == "up":
                 _, idx, pid, version = msg
-                respawned = False
+                respawned = self._table.up(idx, pid)
+                if respawned:
+                    self.respawns_observed += 1
                 with self._lock:
-                    if idx in self._pids and self._pids[idx] != pid:
-                        self.respawns_observed += 1
-                        respawned = True
-                        # the new incarnation holds nothing in hand
-                        self._loads[idx] = 0
-                    self._live.add(idx)
-                    self._pids[idx] = pid
                     self._versions[idx] = version
                 self._registered.set()
                 telemetry.event("serve/replica_up", replica=idx, pid=pid,
@@ -581,15 +544,10 @@ class ReplicaPool:
                     # old incarnation owned.
                     self._redispatch({idx})
             elif kind == "down":
-                with self._lock:
-                    self._live.discard(msg[1])
+                self._table.down(msg[1])
             elif kind == "done":
                 _, idx, batch_id, payload, meta = msg
-                with self._lock:
-                    entry = self._inflight.pop(batch_id, None)
-                    if entry is not None:
-                        i = entry["replica"]
-                        self._loads[i] = max(0, self._loads.get(i, 1) - 1)
+                entry = self._table.pop(("batch", batch_id))
                 if entry is None:
                     continue  # duplicate answer after a re-dispatch
                 try:
@@ -599,39 +557,25 @@ class ReplicaPool:
                     entry["batch"].fail(e)
             elif kind == "batch_error":
                 _, idx, batch_id, tb = msg
-                with self._lock:
-                    entry = self._inflight.pop(batch_id, None)
-                    if entry is not None:
-                        i = entry["replica"]
-                        self._loads[i] = max(0, self._loads.get(i, 1) - 1)
+                entry = self._table.pop(("batch", batch_id))
                 if entry is not None:
                     entry["batch"].fail(RuntimeError(
                         f"replica {idx} failed the batch:\n{tb}"))
             elif kind == "gen_token":
                 _, idx, sid, tindex, tok = msg
-                with self._lock:
-                    entry = self._sessions.get(sid)
-                    if entry is not None:
-                        entry["t"] = time.monotonic()  # streaming = alive
+                # touch: a streamed token proves the stream is alive
+                entry = self._table.touch(("gen", sid))
                 if entry is not None:
                     entry["session"]._token(tindex, tok)
             elif kind == "gen_done":
                 _, idx, sid, tokens, meta = msg
-                with self._lock:
-                    entry = self._sessions.pop(sid, None)
-                    if entry is not None:
-                        i = entry["replica"]
-                        self._loads[i] = max(0, self._loads.get(i, 1) - 1)
+                entry = self._table.pop(("gen", sid))
                 if entry is None:
                     continue  # duplicate answer after a re-dispatch
                 entry["session"]._set(tokens, meta)
             elif kind == "gen_error":
                 _, idx, sid, err = msg
-                with self._lock:
-                    entry = self._sessions.pop(sid, None)
-                    if entry is not None:
-                        i = entry["replica"]
-                        self._loads[i] = max(0, self._loads.get(i, 1) - 1)
+                entry = self._table.pop(("gen", sid))
                 if entry is not None:
                     entry["session"]._fail(RuntimeError(
                         f"replica {idx} failed the decode session: {err}"))
@@ -654,89 +598,49 @@ class ReplicaPool:
         (Batch resolves once, so duplicated answers are no-ops)."""
         while not self._stop.wait(0.2):
             now = time.monotonic()
-            dead = []
-            with self._lock:
-                live = list(self._live)
-            for idx in live:
-                if not self._proc_alive(idx):
-                    dead.append((idx, "process death"))
-                    continue
-                age = self._beat_age(idx)
-                if age is not None and age > tfmanager.stale_after():
-                    dead.append((idx, f"heartbeat stale ({age:.1f}s)"))
+            dead = liveness.scan(self._table.live(), self._proc_alive,
+                                 self._beat_age, tfmanager.stale_after())
             for idx, why in dead:
-                with self._lock:
-                    self._live.discard(idx)
-                    self._loads.pop(idx, None)
+                self._table.lost(idx)
                 telemetry.event("serve/replica_lost", replica=idx,
                                 reason=why)
                 logger.warning("replica %d lost (%s); re-dispatching its "
                                "in-flight batches", idx, why)
             if dead:
                 self._redispatch({idx for idx, _ in dead})
-            # request timeout: fail batches stuck past the deadline so
-            # clients see an error instead of their full wait
-            if self._request_timeout:
-                stale = []
-                with self._lock:
-                    for bid, entry in list(self._inflight.items()):
-                        if now - entry["t"] > self._request_timeout:
-                            stale.append(self._inflight.pop(bid))
-                for entry in stale:
+            # request timeout: fail requests stuck past the deadline so
+            # clients see an error instead of their full wait.  A decode
+            # session's ``t`` refreshes on every streamed token
+            # (collect), so only a genuinely stalled stream times out —
+            # not a long, healthy generation.
+            for key, entry in self._table.stale(self._request_timeout, now):
+                if key[0] == "batch":
                     entry["batch"].fail(TimeoutError(
                         "batch not answered within "
                         f"{self._request_timeout}s"))
-                # decode sessions: ``t`` refreshes on every streamed
-                # token (collect), so only a genuinely stalled stream
-                # times out — not a long, healthy generation
-                stale_s = []
-                with self._lock:
-                    for sid, entry in list(self._sessions.items()):
-                        if now - entry["t"] > self._request_timeout:
-                            stale_s.append(self._sessions.pop(sid))
-                for entry in stale_s:
+                else:
                     entry["session"]._fail(TimeoutError(
                         "decode session streamed no token within "
                         f"{self._request_timeout}s"))
 
     def _redispatch(self, dead_idxs):
-        with self._lock:
-            orphans = [e for e in self._inflight.values()
-                       if e["replica"] in dead_idxs]
-            target_pool = sorted(self._live)
-        for entry in orphans:
-            with self._lock:
-                if not self._live:
-                    # engine supervision will respawn the executor and
-                    # its inbox survives: leave the batch assigned — the
-                    # respawned replica drains the queue it inherited
-                    break
-                idx = self._pick_replica_locked()
-                entry["replica"] = idx
-                entry["t"] = time.monotonic()
-                self._loads[idx] = self._loads.get(idx, 0) + 1
-            self._inqs[idx].put(
-                ("batch", entry["batch"].id, entry["blob"]))
-        # decode sessions of the dead replica: re-send for a full
-        # re-prefill on a survivor.  Greedy decode is deterministic, so
-        # the survivor re-streams identical (index, token) pairs — the
-        # session ledger keeps first arrivals and _set resolves once.
-        with self._lock:
-            orphan_sessions = [e for e in self._sessions.values()
-                               if e["replica"] in dead_idxs]
-        for entry in orphan_sessions:
-            with self._lock:
-                if not self._live:
-                    break  # respawned replica inherits its inbox
-                idx = self._pick_replica_locked()
-                entry["replica"] = idx
-                entry["t"] = time.monotonic()
-                self._loads[idx] = self._loads.get(idx, 0) + 1
-            self._inqs[idx].put(
-                ("gen", entry["session"].id, entry["blob"]))
-        if (orphans or orphan_sessions) and target_pool:
-            telemetry.event("serve/redispatch", batches=len(orphans),
-                            sessions=len(orphan_sessions), to=target_pool)
+        """Re-send a dead replica's in-flight work to survivors.  Decode
+        sessions re-prefill fully on their new replica; greedy decode is
+        deterministic, so the survivor re-streams identical (index,
+        token) pairs — the session ledger keeps first arrivals and _set
+        resolves once.  With no survivor the entries stay assigned: the
+        engine-respawned replica drains the inbox it inherited."""
+        moved = {"batch": 0, "gen": 0}
+        for key in self._table.owned_by(dead_idxs):
+            idx = self._table.reassign(key)
+            entry = self._table.get(key)
+            if idx is None or entry is None:
+                continue
+            self._inqs[idx].put((key[0], key[1], entry["blob"]))
+            moved[key[0]] += 1
+        if moved["batch"] or moved["gen"]:
+            telemetry.event("serve/redispatch", batches=moved["batch"],
+                            sessions=moved["gen"], to=self._table.live())
 
     def _proc_alive(self, idx):
         procs = getattr(self._engine, "_procs", None)
@@ -748,11 +652,7 @@ class ReplicaPool:
             return True
 
     def _beat_age(self, idx):
-        try:
-            v = self._mgr.get(HEARTBEAT_PREFIX + str(idx))
-            return None if v is None else max(0.0, time.time() - float(v))
-        except Exception:  # noqa: BLE001 - manager tearing down
-            return None
+        return liveness.beat_age(self._mgr, HEARTBEAT_PREFIX + str(idx))
 
     def _watch_reload(self):
         """Poll utils/checkpoint.latest; broadcast in-band reloads."""
@@ -772,9 +672,7 @@ class ReplicaPool:
             metrics_registry.inc("tfos_serve_reloads_total")
             telemetry.event(telemetry.SERVE_RELOAD, step=step)
             logger.info("hot-reload: broadcasting checkpoint step %d", step)
-            with self._lock:
-                targets = sorted(self._live)
-            for idx in targets:
+            for idx in self._table.live():
                 try:
                     self._inqs[idx].put(("reload",))
                 except Exception:  # noqa: BLE001
@@ -782,12 +680,10 @@ class ReplicaPool:
 
     # -- introspection ---------------------------------------------------------
     def live_replicas(self):
-        with self._lock:
-            return sorted(self._live)
+        return self._table.live()
 
     def replica_pids(self):
-        with self._lock:
-            return dict(self._pids)
+        return self._table.pids()
 
     def versions(self):
         with self._lock:
@@ -796,8 +692,7 @@ class ReplicaPool:
     def stats(self, timeout=10.0):
         """Broadcast a stats request; gather per-replica predictor stats
         (compile counts per signature, batches, rows, version)."""
-        with self._lock:
-            targets = sorted(self._live)
+        targets = self._table.live()
         self._stats_replies = {}
         self._stats_event.clear()
         for idx in targets:
