@@ -830,7 +830,8 @@ def main():
                      ("serve", _serve_bench),
                      ("decode", _decode_bench),
                      ("data", _data_bench),
-                     ("elastic", _elastic_bench)):
+                     ("elastic", _elastic_bench),
+                     ("actors", _actors_bench)):
         if os.environ.get(f"TFOS_BENCH_{name.upper()}", "1") != "0":
             try:
                 with telemetry.span(f"bench/{name}"):
@@ -1412,6 +1413,54 @@ def _elastic_bench(dev, on_tpu):
             f"bench_elastic rc={proc.returncode}: "
             f"{(proc.stderr or proc.stdout)[-300:]}")
     return json.loads(lines[-1])
+
+
+def _actors_bench(dev, on_tpu):
+    """Actor-substrate micro-lane (TFOS_BENCH_ACTORS=0 to skip): ask
+    round-trip latency through the mailbox wire and SIGKILL->respawn
+    resume time on a 2-member EchoActor group (docs/actors.md).
+    Members run with a scrubbed CPU env and never import jax, so the
+    lane is safe alongside a TPU claim the main process holds."""
+    from tensorflowonspark_tpu.actors import (
+        ActorSystem, EchoActor, SupervisionPolicy,
+    )
+
+    n = int(os.environ.get("TFOS_BENCH_ACTORS_N", "200"))
+    pol = SupervisionPolicy(heartbeat_secs=0.2, stale_secs=5.0,
+                            tick_secs=0.1)
+    with ActorSystem(2, env={"JAX_PLATFORMS": "cpu",
+                             "PYTHONPATH": ""}) as system:
+        g = system.spawn(EchoActor(), "bench", count=2, policy=pol)
+        for i in range(10):  # warm the wire (queue proxies, pickler)
+            g.ask("echo", i).result(60)
+        lat = []
+        for i in range(n):
+            t0 = time.perf_counter()
+            g.ask("echo", i).result(60)
+            lat.append((time.perf_counter() - t0) * 1e3)
+        lat.sort()
+        # failover clock: SIGKILL member 0, time until the supervisor
+        # has observed the respawn AND the slot answers again
+        pid0 = g.ask("pid", index=0).result(60)
+        t0 = time.perf_counter()
+        g.tell("crash", index=0)
+        resumed = None
+        while time.perf_counter() - t0 < 120:
+            try:
+                changed = g.ask("pid", index=0).result(10) != pid0
+            except Exception:  # noqa: BLE001 - mid-failover ask may fail
+                changed = False
+            if changed and g.respawns_observed >= 1:
+                resumed = (time.perf_counter() - t0) * 1e3
+                break
+        if resumed is None:
+            raise RuntimeError("member never respawned within 120s")
+        return {
+            "asks": n,
+            "ask_p50_ms": round(lat[n // 2], 3),
+            "ask_p99_ms": round(lat[min(n - 1, int(n * 0.99))], 3),
+            "respawn_resume_ms": round(resumed, 1),
+        }
 
 
 if __name__ == "__main__":

@@ -68,6 +68,10 @@ LANES = (
     ("decode.tok_p99_ms", ("extra", "decode", "tok_p99_ms"), False),
     ("elastic.resize_ms", ("extra", "elastic", "resize_ms"), False),
     ("elastic.reshard_ms", ("extra", "elastic", "reshard_ms"), False),
+    ("actors.ask_p50_ms", ("extra", "actors", "ask_p50_ms"), False),
+    ("actors.ask_p99_ms", ("extra", "actors", "ask_p99_ms"), False),
+    ("actors.respawn_resume_ms",
+     ("extra", "actors", "respawn_resume_ms"), False),
 )
 
 

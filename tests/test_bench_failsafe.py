@@ -89,7 +89,8 @@ def test_dead_relay_ignore_env_presses_on():
         TFOS_BENCH_TFRECORD_READ="0", TFOS_BENCH_SEGMENTATION="0",
         TFOS_BENCH_BATCH_INFERENCE="0", TFOS_BENCH_SERVE="0",
         TFOS_BENCH_DECODE="0", TFOS_BENCH_DATA="0",
-        TFOS_BENCH_ELASTIC="0", TFOS_BENCH_STEPS="1",
+        TFOS_BENCH_ELASTIC="0", TFOS_BENCH_ACTORS="0",
+        TFOS_BENCH_STEPS="1",
     )
     # note: JAX_PLATFORMS stays unset so the gate engages; the fake
     # PYTHONPATH hook does not exist, so jax falls back to CPU
@@ -117,6 +118,7 @@ def test_fed_lane_vs_device_resident_regression():
         TFOS_BENCH_SEGMENTATION="0", TFOS_BENCH_BATCH_INFERENCE="0",
         TFOS_BENCH_SERVE="0", TFOS_BENCH_DECODE="0",
         TFOS_BENCH_DATA="0", TFOS_BENCH_ELASTIC="0",
+        TFOS_BENCH_ACTORS="0",
         TFOS_BENCH_FED_AB="0",  # one lane is enough for the gate
         # keep the lane's own stall diagnostics reachable BEFORE the
         # subprocess timeout kills the child opaquely
