@@ -491,6 +491,187 @@ def decode_step(params, tokens, cfg: Config, cache_k, cache_v, lengths):
             new_v.transpose(1, 0, 2, 3, 4))
 
 
+def decode_step_paged(params, tokens, cfg: Config, pool_k, pool_v,
+                      block_tables, lengths):
+    """One fused decode iteration over a block-paged KV pool.
+
+    The windowed generalization of ``decode_step`` for
+    ``kvcache.PagedKVCache``: ``tokens`` [S, W] int32 is a WINDOW of W
+    tokens per slot (W=1 is the plain paged step; W=K is the
+    speculative-verify step over a draft window), token j of slot s
+    sitting at logical position ``lengths[s] + j``.  ``pool_k``/
+    ``pool_v`` are the shared pools [num_blocks, n_layers, n_heads,
+    block_size, head_dim]; ``block_tables`` [S, blocks_per_slot] int32
+    maps each slot's logical blocks to physical ones (unused entries
+    point at sentinel block 0); ``lengths`` [S] int32.  Returns
+    ``(logits [S, W, vocab] float32, new_pool_k, new_pool_v)``.
+
+    Write discipline: every window token's k/v is scattered to
+    ``table[s, pos//bs]*bs + pos%bs``; positions past the slot's mapped
+    capacity are routed into the sentinel block, so a window that
+    overruns ``max_seq`` can never clobber another slot's live blocks.
+    Query j attends ``position <= lengths[s] + j`` — causal inside the
+    window, and stale entries past a rejected draft's rollback cursor
+    are unreachable until a later (correct) write lands on them.  Free
+    slots (length 0, all-sentinel table) stay numerically inert exactly
+    as in ``decode_step``.
+    """
+    dtype = cfg.compute_dtype
+    h, hd = cfg.n_heads, cfg.head_dim
+    s_slots, w = tokens.shape
+    nb = pool_k.shape[0]
+    bs = pool_k.shape[3]
+    nbs = block_tables.shape[1]
+    cap = nbs * bs                        # per-slot mapped capacity
+    lengths = jnp.asarray(lengths, jnp.int32)
+    tables = jnp.asarray(block_tables, jnp.int32)
+    scale = 1.0 / (hd ** 0.5)
+
+    # positions of the window tokens, [S, W]
+    pos = lengths[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]
+    posc = jnp.clip(pos, 0, cap - 1)
+    # scatter rows into the flattened [NB*bs, H, D] pool; overflow
+    # (pos >= cap) lands in the sentinel block's matching row
+    blk = jnp.take_along_axis(tables, posc // bs, axis=1)   # [S, W]
+    widx = jnp.where(pos < cap, blk * bs + posc % bs, pos % bs)
+    widx = widx.reshape(-1)
+    # gather map: every slot's mapped positions, [S, cap]
+    gidx = (tables[:, :, None] * bs
+            + jnp.arange(bs, dtype=jnp.int32)).reshape(s_slots, cap)
+    # [S, 1, W, cap] — query j sees position <= lengths + j
+    kv_mask = (jnp.arange(cap)[None, None, None, :]
+               <= pos[:, None, :, None])
+
+    x = params["embed"].astype(dtype)[tokens]               # [S, W, dim]
+    cos, sin = ops.rope_angles(cap, cfg.head_dim, cfg.rope_base)
+
+    def body(carry, inp):
+        x, = carry
+        p, pk_l, pv_l = inp             # pk_l/pv_l: [NB, H, bs, D]
+        y = ops.rmsnorm_reference(x, p["ln1"])
+        qkv = _matmul(y, p["wqkv"]).reshape(s_slots, w, 3, h, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q = ops.apply_rope(q, cos, sin, positions=posc)
+        k = ops.apply_rope(k, cos, sin, positions=posc)
+        # flatten pool block axis with its in-block axis: [NB*bs, H, D]
+        pk_f = pk_l.transpose(0, 2, 1, 3).reshape(nb * bs, h, hd)
+        pv_f = pv_l.transpose(0, 2, 1, 3).reshape(nb * bs, h, hd)
+        pk_f = pk_f.at[widx].set(k.reshape(-1, h, hd))
+        pv_f = pv_f.at[widx].set(v.reshape(-1, h, hd))
+        kg = pk_f[gidx].astype(jnp.float32)          # [S, cap, H, D]
+        vg = pv_f[gidx].astype(jnp.float32)
+        qf = q.astype(jnp.float32)                   # [S, W, H, D]
+        scores = jnp.einsum("swhd,smhd->shwm", qf, kg) * scale
+        scores = jnp.where(kv_mask, scores, _NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("shwm,smhd->swhd", probs, vg)
+        attn = attn.astype(dtype).reshape(s_slots, w, h * hd)
+        x = x + _matmul(attn, p["wo"])
+        y = ops.rmsnorm_reference(x, p["ln2"])
+        y = _matmul(jax.nn.gelu(_matmul(y, p["w1"])), p["w2"])
+        pk_l = pk_f.reshape(nb, bs, h, hd).transpose(0, 2, 1, 3)
+        pv_l = pv_f.reshape(nb, bs, h, hd).transpose(0, 2, 1, 3)
+        return (x + y,), (pk_l, pv_l)
+
+    # scan over layers: pools arrive [NB, L, ...] -> scan axis leading
+    (x,), (new_k, new_v) = lax.scan(
+        body, (x,),
+        (params["layers"],
+         pool_k.transpose(1, 0, 2, 3, 4), pool_v.transpose(1, 0, 2, 3, 4)))
+    x = ops.rmsnorm_reference(x, params["ln_f"])
+    logits = _matmul(x, params["head"]).astype(jnp.float32)
+    return (logits,
+            new_k.transpose(1, 0, 2, 3, 4),
+            new_v.transpose(1, 0, 2, 3, 4))
+
+
+def prefill_extend(params, tokens, cfg: Config, pool_k, pool_v,
+                   prefix_tables, prefix_lens, *, lengths=None):
+    """Tail prefill on top of trie-matched resident prefix blocks.
+
+    The prefix-sharing half of admission: the matched prompt prefix's
+    k/v already live in the paged pool, so only the unmatched TAIL is
+    computed.  ``tokens`` [B, T] int32 right-padded tails; ``lengths``
+    [B] true tail lengths (default: all T); ``prefix_tables``
+    [B, nbp] int32 physical blocks of each row's matched prefix (pad
+    rows with sentinel 0); ``prefix_lens`` [B] int32 matched token
+    counts (whole blocks, possibly 0).  Tail queries attend the
+    gathered prefix (masked to ``position < prefix_lens``) plus the
+    tail causally; rope positions are ``prefix_lens + arange(T)``.
+
+    Returns ``(logits [B, vocab] float32 at the last REAL tail
+    position, k, v [B, n_layers, n_heads, T, head_dim])`` — the tail
+    k/v in prefill layout, which ``PagedKVCache.insert_tail`` scatters
+    into the slot's private blocks (the tail starts block-aligned, so
+    the writes never touch shared blocks).
+    """
+    dtype = cfg.compute_dtype
+    h, hd = cfg.n_heads, cfg.head_dim
+    b, t = tokens.shape
+    nb = pool_k.shape[0]
+    bs = pool_k.shape[3]
+    nbp = prefix_tables.shape[1]
+    pcap = nbp * bs
+    plens = jnp.asarray(prefix_lens, jnp.int32)
+    ptab = jnp.asarray(prefix_tables, jnp.int32)
+    scale = 1.0 / (hd ** 0.5)
+
+    pos = plens[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+    cos, sin = ops.rope_angles(pcap + t, cfg.head_dim, cfg.rope_base)
+    gidx = (ptab[:, :, None] * bs
+            + jnp.arange(bs, dtype=jnp.int32)).reshape(b, pcap)
+    # [B, 1, 1, P] prefix visibility; [T, T] causal within the tail
+    pmask = (jnp.arange(pcap)[None, :] < plens[:, None])[:, None, None, :]
+    cmask = (jnp.arange(t)[None, :] <= jnp.arange(t)[:, None]
+             )[None, None, :, :]
+
+    x = params["embed"].astype(dtype)[tokens]               # [B, T, dim]
+
+    def layer(carry, inp):
+        x, = carry
+        p, pk_l, pv_l = inp
+        y = ops.rmsnorm_reference(x, p["ln1"])
+        qkv = _matmul(y, p["wqkv"]).reshape(b, t, 3, h, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q = ops.apply_rope(q, cos, sin, positions=pos)
+        k = ops.apply_rope(k, cos, sin, positions=pos)
+        pk_f = pk_l.transpose(0, 2, 1, 3).reshape(nb * bs, h, hd)
+        pv_f = pv_l.transpose(0, 2, 1, 3).reshape(nb * bs, h, hd)
+        kp = pk_f[gidx].astype(jnp.float32)          # [B, P, H, D]
+        vp = pv_f[gidx].astype(jnp.float32)
+        qf = q.astype(jnp.float32)
+        sp = jnp.einsum("bthd,bphd->bhtp", qf, kp) * scale
+        st = jnp.einsum("bthd,bshd->bhts", qf,
+                        k.astype(jnp.float32)) * scale
+        sp = jnp.where(pmask, sp, _NEG_INF)
+        st = jnp.where(cmask, st, _NEG_INF)
+        probs = jax.nn.softmax(
+            jnp.concatenate([sp, st], axis=-1), axis=-1)
+        pp, pt = probs[..., :pcap], probs[..., pcap:]
+        attn = (jnp.einsum("bhtp,bphd->bthd", pp, vp)
+                + jnp.einsum("bhts,bshd->bthd", pt,
+                             v.astype(jnp.float32)))
+        attn = attn.astype(dtype).reshape(b, t, h * hd)
+        x = x + _matmul(attn, p["wo"])
+        y = ops.rmsnorm_reference(x, p["ln2"])
+        y = _matmul(jax.nn.gelu(_matmul(y, p["w1"])), p["w2"])
+        return (x + y,), (k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3))
+
+    (x,), (k, v) = lax.scan(
+        layer, (x,),
+        (params["layers"],
+         pool_k.transpose(1, 0, 2, 3, 4), pool_v.transpose(1, 0, 2, 3, 4)))
+    x = ops.rmsnorm_reference(x, params["ln_f"])
+    if lengths is None:
+        last = jnp.full((b,), t - 1, jnp.int32)
+    else:
+        last = jnp.asarray(lengths, jnp.int32) - 1
+    x_last = jnp.take_along_axis(
+        x, jnp.clip(last, 0, t - 1)[:, None, None], axis=1)[:, 0]
+    logits = _matmul(x_last, params["head"]).astype(jnp.float32)
+    return logits, k.transpose(1, 0, 2, 3, 4), v.transpose(1, 0, 2, 3, 4)
+
+
 def greedy_decode_reference(params, prompt, cfg: Config, *, max_tokens,
                             eos_id=None, attn_fn=None):
     """Full-recompute greedy decode — the KV-cache parity oracle

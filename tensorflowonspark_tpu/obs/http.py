@@ -106,6 +106,15 @@ def node_summary(snap):
         occ = _metric_gauge(snap, "tfos_decode_slot_occupancy")
         if occ is not None:
             out["decode_slots_busy"] = occ
+        hits = _metric_total(snap, "tfos_decode_prefix_hits")
+        if hits:
+            out["decode_prefix_hits"] = hits
+        blocks = _metric_gauge(snap, "tfos_decode_blocks_in_use")
+        if blocks is not None:
+            out["decode_blocks_in_use"] = blocks
+        acc = _metric_gauge(snap, "tfos_decode_spec_accept")
+        if acc is not None:
+            out["decode_spec_accept"] = _round(acc, 4)
     return {k: v for k, v in out.items() if v is not None}
 
 

@@ -31,6 +31,38 @@ def _pct(sorted_vals, q):
     return sorted_vals[idx]
 
 
+def shared_prefix_prompts(n, *, vocab_size, prefix_pool=4, prefix_len=64,
+                          prefix_frac=0.6, tail_lo=9, tail_hi=16, seed=0):
+    """Decode-lane traffic with a shared-system-prompt population.
+
+    Returns ``(prompts, pool)``: ``prompts`` is ``n`` token-id lists of
+    which a seeded ``prefix_frac`` fraction start with one of
+    ``prefix_pool`` fixed ``prefix_len``-token "system prompts"
+    (followed by a unique random tail), the rest are fully random —
+    the fan-in shape the prefix trie exists for.  ``pool`` is the list
+    of system prompts, so callers can warm the trie or compute
+    expected savings.
+
+    The prefix length is FIXED and the tail band ``[tail_lo,
+    tail_hi]`` narrow, so shared-prefix requests fall into one
+    (tail-bucket, prefix-block-bucket) compile group — the bench A/B
+    measures paging, not compile-cache asymmetry.  Tokens stay in
+    ``[1, vocab_size)``: 0 is left out so prompts never collide with
+    inert padding.  Pure stdlib.
+    """
+    rng = random.Random(seed)
+    draw = lambda ln: [rng.randrange(1, int(vocab_size)) for _ in range(ln)]
+    pool = [draw(int(prefix_len)) for _ in range(int(prefix_pool))]
+    prompts = []
+    for _ in range(int(n)):
+        tail = draw(rng.randint(int(tail_lo), int(tail_hi)))
+        if rng.random() < float(prefix_frac):
+            prompts.append(rng.choice(pool) + tail)
+        else:
+            prompts.append(draw(int(prefix_len)) + tail)
+    return prompts, pool
+
+
 def run_open_loop(request_fn, *, rate_rps, n_requests, seed=0,
                   shed_exc=None):
     """Fire ``n_requests`` calls of ``request_fn(i)`` at Poisson arrivals

@@ -10,19 +10,38 @@ scheduler the serving tier mounts behind
   iteration after a slot frees up;
 - each iteration runs (1) prefill for newly admitted prompts
   (sequence- and row-bucketed so compile count stays
-  ``O(log slots · log max_seq)``), then (2) ONE fused
-  ``models/transformer.decode_step`` over every occupied slot;
+  ``O(log slots · log max_seq)``), then (2) ONE fused decode step over
+  every occupied slot;
 - a finished sequence (EOS or ``max_tokens``) retires its slot
   immediately and the slot is eligible for re-admission in the same
   loop pass.
 
+Three engine upgrades ride the same loop (all default-on via env,
+all token-exact against the full-recompute oracle):
+
+- **Block-paged KV with prefix sharing** (``TFOS_DECODE_PAGED``):
+  the cache is a :class:`~.kvcache.PagedKVCache`; admission matches
+  each prompt against the resident prefix trie and maps the shared
+  blocks (refcount bump) instead of re-prefilling them — only the
+  unmatched tail runs ``models/transformer.prefill_extend``.
+- **Seeded sampling** (per-session temperature/top-k/top-p/seed,
+  ``serving/decode/sampling.py``): logits come back to the host and
+  the token is a pure function of ``(logits, params, index)``, so a
+  failover replay re-draws the identical stream.
+- **Speculative decoding** (``spec_window`` + a draft model): the
+  draft proposes K-1 tokens, the verify step is ONE windowed
+  ``decode_step_paged`` over the K-token window, and a draft token is
+  accepted iff it EQUALS the target's seeded sample at that index —
+  so speculative output is byte-identical to non-speculative at the
+  same seed, not merely distribution-preserving.
+
 Tokens stream back through the resolve-once machinery the predict path
 already uses (batcher.PendingResult semantics): the driver-side
 :class:`PendingSession` keys its token ledger by index, so a failover
-replay after a replica SIGKILL (greedy decode is deterministic)
-re-delivers identical ``(index, token)`` pairs — first arrival wins,
-``_set``/``_fail`` resolve once, zero drop and zero dup by
-construction.
+replay after a replica SIGKILL (greedy and seeded-sampled decode are
+both deterministic) re-delivers identical ``(index, token)`` pairs —
+first arrival wins, ``_set``/``_fail`` resolve once, zero drop and
+zero dup by construction.
 
 Module import stays stdlib + numpy (driver-importable); jax and the
 model only load inside :class:`DecodeEngine`'s replica-side thread.
@@ -40,6 +59,7 @@ import numpy as np
 
 from tensorflowonspark_tpu.actors.ledger import IndexLedger, ResolveOnce
 from tensorflowonspark_tpu.serving import batcher as _batcher
+from tensorflowonspark_tpu.serving.decode import sampling as _sampling
 from tensorflowonspark_tpu.utils import metrics_registry
 
 logger = logging.getLogger(__name__)
@@ -47,6 +67,10 @@ logger = logging.getLogger(__name__)
 SLOTS_ENV = "TFOS_DECODE_SLOTS"
 QUEUE_MAX_ENV = "TFOS_DECODE_QUEUE_MAX"
 MAX_TOKENS_ENV = "TFOS_DECODE_MAX_TOKENS"
+PAGED_ENV = "TFOS_DECODE_PAGED"
+BLOCK_ENV = "TFOS_DECODE_BLOCK"
+PREFIX_SHARING_ENV = "TFOS_DECODE_PREFIX_SHARING"
+SPEC_WINDOW_ENV = "TFOS_DECODE_SPEC_WINDOW"
 
 
 def slots_default():
@@ -61,21 +85,73 @@ def max_tokens_default():
     return int(os.environ.get(MAX_TOKENS_ENV, "64"))
 
 
+def paged_default():
+    return os.environ.get(PAGED_ENV, "1") != "0"
+
+
+def block_size_default():
+    return int(os.environ.get(BLOCK_ENV, "16"))
+
+
+def prefix_sharing_default():
+    return os.environ.get(PREFIX_SHARING_ENV, "1") != "0"
+
+
+def spec_window_default():
+    return int(os.environ.get(SPEC_WINDOW_ENV, "4"))
+
+
 class DecodeSpec:
     """The decode tier's picklable config, carried to replicas inside
     the ModelSpec payload (replicas.ModelSpec(..., decode=...)).
 
     ``cfg`` is a ``models/transformer.Config``; ``slots`` sizes the
-    :class:`~.kvcache.SlotKVCache`; ``eos_id``/``max_tokens`` are
-    per-session defaults a request may override (``max_tokens`` is
-    always clamped to the cache page, ``max_seq - len(prompt)``).
+    KV cache; ``eos_id``/``max_tokens`` are per-session defaults a
+    request may override (``max_tokens`` is always clamped to the
+    cache page, ``max_seq - len(prompt)``).
+
+    Paged-cache knobs (defaults from env): ``paged`` selects
+    :class:`~.kvcache.PagedKVCache` over the legacy
+    :class:`~.kvcache.SlotKVCache`; ``block_size``/``num_blocks`` size
+    it; ``prefix_sharing`` arms the prefix trie.  Speculative decoding
+    arms when BOTH ``draft_params`` (a transformer params pytree) and
+    ``draft_cfg`` are given: the draft proposes ``spec_window - 1``
+    tokens per iteration and one windowed verify step scores them
+    (paged mode only — the verify step is ``decode_step_paged``).
     """
 
-    def __init__(self, cfg, slots=None, eos_id=None, max_tokens=None):
+    def __init__(self, cfg, slots=None, eos_id=None, max_tokens=None,
+                 paged=None, block_size=None, num_blocks=None,
+                 prefix_sharing=None, draft_params=None, draft_cfg=None,
+                 spec_window=None):
         self.cfg = cfg
         self.slots = int(slots or slots_default())
         self.eos_id = eos_id
         self.max_tokens = int(max_tokens or max_tokens_default())
+        self.paged = paged_default() if paged is None else bool(paged)
+        self.block_size = int(block_size or block_size_default())
+        self.num_blocks = num_blocks
+        self.prefix_sharing = (prefix_sharing_default()
+                               if prefix_sharing is None
+                               else bool(prefix_sharing))
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
+        self.spec_window = int(spec_window or spec_window_default())
+        if self.spec_window < 2:
+            raise ValueError(
+                f"spec_window must be >= 2, got {self.spec_window}")
+        if (draft_params is None) != (draft_cfg is None):
+            raise ValueError(
+                "speculative decoding needs BOTH draft_params and "
+                "draft_cfg (or neither)")
+        if draft_params is not None and not self.paged:
+            raise ValueError(
+                "speculative decoding requires paged=True (the verify "
+                "step is decode_step_paged)")
+
+    @property
+    def speculative(self):
+        return self.draft_params is not None
 
 
 class PendingSession(ResolveOnce):
@@ -85,21 +161,25 @@ class PendingSession(ResolveOnce):
 
     The :class:`~tensorflowonspark_tpu.actors.ledger.IndexLedger` keys
     on token INDEX: after a replica SIGKILL the session re-prefills on a
-    survivor and greedy decode re-streams the same ``(index, token)``
-    pairs — the first arrival of an index wins (its timestamp included,
-    so TTFT/per-token stats survive failover), and a duplicate
-    ``gen_done`` is swallowed by the resolve-once gate.
+    survivor and decode re-streams the same ``(index, token)`` pairs
+    (greedy is deterministic; seeded sampling is a pure function of
+    ``(logits, params, index)``, and the ``sampling`` dict — seed
+    included — rides the dispatch blob, so the replay draws the same
+    variates) — the first arrival of an index wins (its timestamp
+    included, so TTFT/per-token stats survive failover), and a
+    duplicate ``gen_done`` is swallowed by the resolve-once gate.
     """
 
-    __slots__ = ("id", "prompt", "max_tokens", "eos_id", "t_submit",
-                 "_ledger")
+    __slots__ = ("id", "prompt", "max_tokens", "eos_id", "sampling",
+                 "t_submit", "_ledger")
 
-    def __init__(self, sid, prompt, max_tokens, eos_id):
+    def __init__(self, sid, prompt, max_tokens, eos_id, sampling=None):
         super().__init__()
         self.id = sid
         self.prompt = [int(t) for t in prompt]
         self.max_tokens = int(max_tokens)
         self.eos_id = eos_id
+        self.sampling = sampling
         self.t_submit = time.perf_counter()
         self._ledger = IndexLedger()   # index -> token, first arrival wins
 
@@ -145,13 +225,15 @@ class _Slot:
     """Replica-side per-slot generation state."""
 
     __slots__ = ("sid", "prompt_len", "generated", "max_tokens", "eos_id",
-                 "last", "t_admit")
+                 "sampling", "last", "t_admit")
 
-    def __init__(self, sid, prompt_len, max_tokens, eos_id, first_token):
+    def __init__(self, sid, prompt_len, max_tokens, eos_id, first_token,
+                 sampling=None):
         self.sid = sid
         self.prompt_len = prompt_len
         self.max_tokens = max_tokens
         self.eos_id = eos_id
+        self.sampling = sampling
         self.generated = [first_token]
         self.last = first_token
         self.t_admit = time.perf_counter()
@@ -180,6 +262,7 @@ class DecodeEngine:
         self._qlock = threading.Lock()
         self._sids = set()          # sids queued or active (dedupe)
         self._active = {}           # slot index -> _Slot
+        self._cache = None          # engine-thread cache, read by stats()
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread = None
@@ -188,6 +271,10 @@ class DecodeEngine:
         self.iterations = 0
         self.prefills = 0
         self.retired = 0
+        self.prefix_hits = 0
+        self.prefix_tokens_saved = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
 
     # -- lifecycle ----------------------------------------------------------
     def start(self, timeout=120.0):
@@ -214,7 +301,8 @@ class DecodeEngine:
         no-drop semantics as the predict path's reload."""
         self._params = params
 
-    def submit(self, sid, prompt, max_tokens=None, eos_id=None):
+    def submit(self, sid, prompt, max_tokens=None, eos_id=None,
+               sampling=None):
         """Queue one session; admission happens at the next iteration.
         Rejections (prompt too long, duplicate sid) are emitted as
         session errors, not raised — submit is called from the replica's
@@ -234,47 +322,98 @@ class DecodeEngine:
                 "sid": sid, "prompt": prompt,
                 "max_tokens": int(max_tokens or self._spec.max_tokens),
                 "eos_id": self._spec.eos_id if eos_id is None else eos_id,
+                "sampling": sampling,
             })
         self._wake.set()
 
     def stats(self):
         with self._qlock:
             queued = len(self._q)
-        return {
+        out = {
             "iterations": self.iterations,
             "prefills": self.prefills,
             "retired": self.retired,
             "active": len(self._active),
             "queued": queued,
             "slots": self._spec.slots,
+            "paged": self._spec.paged,
         }
+        if self._spec.paged:
+            cache = self._cache
+            out["prefix_hits"] = self.prefix_hits
+            out["prefix_tokens_saved"] = self.prefix_tokens_saved
+            out["blocks_in_use"] = (cache.blocks_in_use
+                                    if cache is not None else 0)
+        if self._spec.speculative:
+            out["spec_proposed"] = self.spec_proposed
+            out["spec_accepted"] = self.spec_accepted
+            out["spec_accept_rate"] = round(
+                self.spec_accepted / max(1, self.spec_proposed), 4)
+        return out
 
     # -- engine thread ------------------------------------------------------
+    def _build_caches(self):
+        spec = self._spec
+        if spec.paged:
+            cache = self._kvcache_mod.PagedKVCache(
+                spec.cfg, spec.slots, block_size=spec.block_size,
+                num_blocks=spec.num_blocks,
+                prefix_sharing=spec.prefix_sharing)
+        else:
+            cache = self._kvcache_mod.SlotKVCache(spec.cfg, spec.slots)
+        dcache = None
+        if spec.speculative:
+            dcache = self._kvcache_mod.SlotKVCache(
+                spec.draft_cfg, spec.slots)
+        self._cache = cache
+        return cache, dcache
+
     def _run(self):
         try:
             import jax
-            import jax.numpy as jnp
+            import jax.numpy as jnp  # noqa: F401 - jit closure imports
 
             from tensorflowonspark_tpu.models import transformer
             from tensorflowonspark_tpu.serving.decode import kvcache
 
-            cfg = self._spec.cfg
+            spec = self._spec
+            cfg = spec.cfg
 
             def _prefill(p, toks, lens):
-                logits, k, v = transformer.prefill(p, toks, cfg,
-                                                   lengths=lens)
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32), k, v
-
-            def _step(p, toks, ck, cv, lens):
-                logits, ck, cv = transformer.decode_step(
-                    p, toks, cfg, ck, cv, lens)
-                return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
-                        ck, cv)
+                return transformer.prefill(p, toks, cfg, lengths=lens)
 
             self._prefill_jit = jax.jit(_prefill)
-            self._step_jit = jax.jit(_step)
+            if spec.paged:
+                def _extend(p, toks, pk, pv, ptab, plens, lens):
+                    return transformer.prefill_extend(
+                        p, toks, cfg, pk, pv, ptab, plens, lengths=lens)
+
+                def _pstep(p, toks, pk, pv, tables, lens):
+                    return transformer.decode_step_paged(
+                        p, toks, cfg, pk, pv, tables, lens)
+
+                self._extend_jit = jax.jit(_extend)
+                self._pstep_jit = jax.jit(_pstep)
+            else:
+                def _step(p, toks, ck, cv, lens):
+                    return transformer.decode_step(
+                        p, toks, cfg, ck, cv, lens)
+
+                self._step_jit = jax.jit(_step)
+            if spec.speculative:
+                dcfg = spec.draft_cfg
+
+                def _dprefill(p, toks, lens):
+                    return transformer.prefill(p, toks, dcfg, lengths=lens)
+
+                def _dstep(p, toks, ck, cv, lens):
+                    return transformer.decode_step(
+                        p, toks, dcfg, ck, cv, lens)
+
+                self._dprefill_jit = jax.jit(_dprefill)
+                self._dstep_jit = jax.jit(_dstep)
             self._kvcache_mod = kvcache
-            cache = kvcache.SlotKVCache(cfg, self._spec.slots)
+            cache, dcache = self._build_caches()
         except BaseException as e:  # noqa: BLE001 - surface via start()
             self._init_error = e
             self._started.set()
@@ -282,22 +421,35 @@ class DecodeEngine:
         self._started.set()
         while not self._stop.is_set():
             try:
-                self._admit(cache)
+                self._admit(cache, dcache)
                 if not self._active:
                     self._wake.wait(0.02)
                     self._wake.clear()
                     continue
-                self._iterate(cache)
+                if self._spec.paged:
+                    self._iterate_paged(cache, dcache)
+                else:
+                    self._iterate(cache)
             except BaseException as e:  # noqa: BLE001 - fail the cohort,
-                # rebuild the cache, keep the replica serving
+                # rebuild the caches, keep the replica serving
                 logger.exception("decode engine iteration failed")
                 self._fail_all(repr(e))
-                cache = self._kvcache_mod.SlotKVCache(
-                    self._spec.cfg, self._spec.slots)
+                cache, dcache = self._build_caches()
 
-    def _admit(self, cache):
-        """Move queued sessions into free slots: bucketed prefill, then
-        first-token emission (the prefill logits ARE token 0)."""
+    # -- admission ----------------------------------------------------------
+    def _admit(self, cache, dcache=None):
+        """Move queued sessions into free slots.
+
+        Paged mode: each prompt is first matched against the prefix
+        trie; a hit maps the shared blocks (refcount bump) and only the
+        unmatched tail runs ``prefill_extend`` — grouped by (tail
+        bucket, prefix-block bucket) so compile count stays
+        logarithmic.  Misses (and slot mode) run the plain bucketed
+        ``prefill``.  Every admitted prompt's whole-block prefix is then
+        offered to the trie, so the FIRST request of a prefix populates
+        it for all followers.  The first token comes from the prefill
+        logits either way (sampled at index 0).
+        """
         batch = []
         with self._qlock:
             while self._q and len(batch) < cache.free_slots:
@@ -305,9 +457,20 @@ class DecodeEngine:
         if not batch:
             return
         cfg = self._spec.cfg
-        # group by sequence bucket so compile count stays logarithmic
-        groups = {}
+        paged = self._spec.paged
+        plain, matched = [], []
         for req in batch:
+            shared, mlen = (cache.match_prefix(req["prompt"])
+                            if paged else ([], 0))
+            if mlen > 0:
+                matched.append((req, shared, mlen))
+            else:
+                plain.append(req)
+
+        admitted = []  # (req, logits_row [vocab], k_i, v_i, shared, mlen)
+        # -- plain bucketed prefill (whole prompt) --------------------------
+        groups = {}
+        for req in plain:
             t = _batcher.bucket_seq(len(req["prompt"]), cfg.max_seq)
             groups.setdefault(t, []).append(req)
         for t, members in groups.items():
@@ -318,39 +481,112 @@ class DecodeEngine:
             lens = np.asarray([len(m["prompt"]) for m in members], np.int32)
             toks = _batcher.pad_rows(toks, rows)
             lens = _batcher.pad_rows(lens, rows)
-            firsts, k, v = self._prefill_jit(self._params, toks, lens)
-            firsts = np.asarray(firsts)
+            logits, k, v = self._prefill_jit(self._params, toks, lens)
+            logits = np.asarray(logits)
             self.prefills += 1
             for i, req in enumerate(members):
-                slot = cache.alloc()
-                # cannot be None: admission is bounded by free_slots
-                cache.insert(slot, k[i], v[i], len(req["prompt"]))
-                first = int(firsts[i])
-                mt = min(req["max_tokens"],
-                         cache.max_seq - len(req["prompt"]))
-                st = _Slot(req["sid"], len(req["prompt"]), max(1, mt),
-                           req["eos_id"], first)
-                self._active[slot] = st
-                self._emit("token", st.sid, 0, first)
-                if (st.eos_id is not None and first == st.eos_id) \
-                        or st.max_tokens <= 1:
-                    self._retire(cache, slot)
+                admitted.append((req, logits[i], k[i], v[i], [], 0))
+        # -- prefix-hit tail prefill ----------------------------------------
+        groups = {}
+        for req, shared, mlen in matched:
+            tail = len(req["prompt"]) - mlen
+            key = (_batcher.bucket_seq(tail, cfg.max_seq),
+                   _batcher.bucket_size(len(shared),
+                                        cache.blocks_per_slot))
+            groups.setdefault(key, []).append((req, shared, mlen))
+        for (t, nbp), members in groups.items():
+            rows = _batcher.bucket_size(len(members), self._spec.slots)
+            toks = np.stack([
+                _batcher.pad_seq(
+                    np.asarray(m[0]["prompt"][m[2]:], np.int32), t)
+                for m in members])
+            lens = np.asarray(
+                [len(m[0]["prompt"]) - m[2] for m in members], np.int32)
+            ptab = np.zeros((len(members), nbp), np.int32)
+            for i, (_req, shared, _mlen) in enumerate(members):
+                ptab[i, :len(shared)] = shared
+            plens = np.asarray([m[2] for m in members], np.int32)
+            toks = _batcher.pad_rows(toks, rows)
+            lens = _batcher.pad_rows(lens, rows)
+            ptab = _batcher.pad_rows(ptab, rows)
+            plens = _batcher.pad_rows(plens, rows)
+            logits, k, v = self._extend_jit(
+                self._params, toks, cache.k, cache.v, ptab, plens, lens)
+            logits = np.asarray(logits)
+            self.prefills += 1
+            for i, (req, shared, mlen) in enumerate(members):
+                admitted.append((req, logits[i], k[i], v[i], shared, mlen))
+                self.prefix_hits += 1
+                self.prefix_tokens_saved += mlen
+                metrics_registry.inc("tfos_decode_prefix_hits")
+        # -- draft prefill (speculative mode: full prompt, own cache) -------
+        draft_kv = {}  # sid -> (k_i, v_i)
+        if dcache is not None:
+            groups = {}
+            for req in batch:
+                t = _batcher.bucket_seq(len(req["prompt"]),
+                                        self._spec.draft_cfg.max_seq)
+                groups.setdefault(t, []).append(req)
+            for t, members in groups.items():
+                rows = _batcher.bucket_size(len(members), self._spec.slots)
+                toks = np.stack([
+                    _batcher.pad_seq(np.asarray(m["prompt"], np.int32), t)
+                    for m in members])
+                lens = np.asarray(
+                    [len(m["prompt"]) for m in members], np.int32)
+                toks = _batcher.pad_rows(toks, rows)
+                lens = _batcher.pad_rows(lens, rows)
+                _lg, dk, dv = self._dprefill_jit(
+                    self._spec.draft_params, toks, lens)
+                for i, req in enumerate(members):
+                    draft_kv[req["sid"]] = (dk[i], dv[i])
+
+        # -- slot installation + first-token emission -----------------------
+        for req, logits_row, k_i, v_i, shared, mlen in admitted:
+            plen = len(req["prompt"])
+            slot = cache.alloc()
+            # cannot be None: admission is bounded by free_slots
+            if paged:
+                bs = cache.block_size
+                own = cache.alloc_blocks(-(-(plen - mlen) // bs))
+                cache.map_session(slot, shared, own, plen)
+                cache.insert_tail(slot, k_i, v_i, mlen, plen - mlen)
+                cache.register_prompt(slot, req["prompt"])
+            else:
+                cache.insert(slot, k_i, v_i, plen)
+            if dcache is not None:
+                dk, dv = draft_kv[req["sid"]]
+                dcache.insert(slot, dk, dv, plen)
+            first = _sampling.sample_token(logits_row, req["sampling"], 0)
+            mt = min(req["max_tokens"], cache.max_seq - plen)
+            st = _Slot(req["sid"], plen, max(1, mt), req["eos_id"], first,
+                       req["sampling"])
+            self._active[slot] = st
+            self._emit("token", st.sid, 0, first)
+            if (st.eos_id is not None and first == st.eos_id) \
+                    or st.max_tokens <= 1:
+                self._retire(cache, slot)
         metrics_registry.set_gauge("tfos_decode_slot_occupancy",
                                    cache.occupancy)
+        if paged:
+            metrics_registry.set_gauge("tfos_decode_blocks_in_use",
+                                       cache.blocks_in_use)
 
+    # -- iteration: legacy slot-paged path ----------------------------------
     def _iterate(self, cache):
         """One fused decode step over every occupied slot."""
         tokens = np.zeros((cache.slots,), np.int32)
         for slot, st in self._active.items():
             tokens[slot] = st.last
-        nxt, cache.k, cache.v = self._step_jit(
+        logits, cache.k, cache.v = self._step_jit(
             self._params, tokens, cache.k, cache.v, cache.lengths)
-        nxt = np.asarray(nxt)
+        logits = np.asarray(logits)
         self.iterations += 1
         for slot in list(self._active):
             st = self._active[slot]
             cache.lengths[slot] += 1
-            tok = int(nxt[slot])
+            tok = _sampling.sample_token(logits[slot], st.sampling,
+                                         len(st.generated))
             st.generated.append(tok)
             st.last = tok
             self._emit("token", st.sid, len(st.generated) - 1, tok)
@@ -360,6 +596,90 @@ class DecodeEngine:
                 self._retire(cache, slot)
         metrics_registry.set_gauge("tfos_decode_slot_occupancy",
                                    cache.occupancy)
+
+    # -- iteration: paged path (plain W=1 or speculative W=K) ---------------
+    def _iterate_paged(self, cache, dcache):
+        """One fused windowed step over every occupied slot.
+
+        Without a draft model the window is 1 token — the plain paged
+        step.  With one, the draft proposes ``K-1`` tokens host-sampled
+        at their future indices, the window ``[last, d_1 .. d_{K-1}]``
+        runs ONE ``decode_step_paged`` verify, and draft token ``d_j``
+        is accepted iff it equals the target's seeded sample at index
+        ``base+j-1`` — every emitted token is exactly the target
+        sample conditioned on a correct history, so speculative output
+        matches non-speculative token-for-token.  The draft ingests the
+        full window (K steps) so its cache stays aligned; rejection
+        rolls both cursors back by assignment, and the stale K/V past
+        the cursor is unreachable (masked) until a later correct write
+        lands on it.
+        """
+        spec = self._spec
+        k_win = spec.spec_window if dcache is not None else 1
+        window = np.zeros((cache.slots, k_win), np.int32)
+        for slot, st in self._active.items():
+            window[slot, 0] = st.last
+        n0 = cache.lengths.copy()
+        if dcache is not None:
+            for j in range(k_win):
+                dlogits, dcache.k, dcache.v = self._dstep_jit(
+                    spec.draft_params, window[:, j], dcache.k, dcache.v,
+                    dcache.lengths)
+                for slot in self._active:
+                    dcache.lengths[slot] += 1
+                if j < k_win - 1:
+                    dlogits = np.asarray(dlogits)
+                    for slot, st in self._active.items():
+                        window[slot, j + 1] = _sampling.sample_token(
+                            dlogits[slot], st.sampling,
+                            len(st.generated) + j)
+        for slot in self._active:
+            cache.ensure_capacity(slot, int(n0[slot]) + k_win)
+        logits, cache.k, cache.v = self._pstep_jit(
+            self._params, window, cache.k, cache.v,
+            cache.block_tables, n0)
+        logits = np.asarray(logits)           # [slots, K, vocab]
+        self.iterations += 1
+        for slot in list(self._active):
+            st = self._active[slot]
+            n = int(n0[slot])
+            base = len(st.generated)
+            # rows past max_seq wrote their token's k/v to the sentinel,
+            # so their logits miss history — never emit from them
+            valid = min(k_win, cache.max_seq - n)
+            emitted = []
+            for j in range(valid):
+                if j > 0 and int(window[slot, j]) != emitted[j - 1]:
+                    break               # draft diverged; later rows stale
+                if j > 0:
+                    self.spec_accepted += 1
+                emitted.append(_sampling.sample_token(
+                    logits[slot, j], st.sampling, base + j))
+            if dcache is not None:
+                self.spec_proposed += k_win - 1
+            done = False
+            for tok in emitted:
+                st.generated.append(tok)
+                st.last = tok
+                cache.lengths[slot] += 1
+                self._emit("token", st.sid, len(st.generated) - 1, tok)
+                if (st.eos_id is not None and tok == st.eos_id) \
+                        or len(st.generated) >= st.max_tokens:
+                    done = True
+                    break
+            if dcache is not None:
+                # roll the draft cursor back onto the accepted prefix
+                dcache.lengths[slot] = cache.lengths[slot]
+            if done or cache.lengths[slot] >= cache.max_seq:
+                self._retire(cache, slot)
+        metrics_registry.set_gauge("tfos_decode_slot_occupancy",
+                                   cache.occupancy)
+        metrics_registry.set_gauge("tfos_decode_blocks_in_use",
+                                   cache.blocks_in_use)
+        if dcache is not None:
+            metrics_registry.set_gauge(
+                "tfos_decode_spec_accept",
+                round(self.spec_accepted / max(1, self.spec_proposed), 4))
 
     def _retire(self, cache, slot):
         st = self._active.pop(slot)

@@ -332,7 +332,8 @@ def _make_replica_task(payload_blob, mgr_addr, mgr_authkey):
                         req = cloudpickle.loads(blob)
                         engine.submit(sid, req["prompt"],
                                       max_tokens=req.get("max_tokens"),
-                                      eos_id=req.get("eos_id"))
+                                      eos_id=req.get("eos_id"),
+                                      sampling=req.get("sampling"))
                     except BaseException as e:  # noqa: BLE001 - one bad
                         # session must not take the replica down
                         outq.put(("gen_error", idx, sid, repr(e)))
@@ -500,6 +501,9 @@ class ReplicaPool:
             "prompt": session.prompt,
             "max_tokens": session.max_tokens,
             "eos_id": session.eos_id,
+            # the resolved sampling dict (seed included) rides the blob,
+            # so a failover re-dispatch replays the identical stream
+            "sampling": getattr(session, "sampling", None),
         })
         idx = self._table.add(("gen", session.id),
                               {"session": session, "blob": blob})

@@ -27,6 +27,7 @@ import argparse
 import itertools
 import json
 import logging
+import random
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -34,6 +35,7 @@ import numpy as np
 
 from tensorflowonspark_tpu.serving import batcher as _batcher
 from tensorflowonspark_tpu.serving.batcher import MicroBatcher, Overloaded
+from tensorflowonspark_tpu.serving.decode import sampling as _sampling
 from tensorflowonspark_tpu.serving.decode import scheduler as _decode
 from tensorflowonspark_tpu.serving.replicas import ModelSpec, ReplicaPool
 from tensorflowonspark_tpu.utils import metrics_registry, telemetry
@@ -270,10 +272,20 @@ class Server:
             metrics_registry.inc("tfos_serve_requests_total", status="error")
             raise
 
-    def generate(self, prompt, max_tokens=None, eos_id=None, timeout=None):
+    def generate(self, prompt, max_tokens=None, eos_id=None, timeout=None,
+                 temperature=None, top_k=None, top_p=None, seed=None):
         """One autoregressive decode session: ``prompt`` is a list of
         int token ids; returns ``{"tokens": [...], "ttft_ms", "token_ms"
         (per-token gaps), "total_ms", ...engine meta}``.
+
+        Sampling: ``temperature > 0`` switches the session from greedy
+        argmax to seeded sampling (``top_k``/``top_p`` optional).  The
+        seed is resolved HERE (random when unset) so the dispatch blob
+        carries it: a failover replay re-draws the identical token
+        stream (decode/sampling.py).  Out-of-range sampling values and
+        invalid prompts raise ValueError (HTTP 400) before dispatch —
+        an oversized prompt is a client error, never a replica-side
+        crash or a shed.
 
         Admission control mirrors ``predict``: past
         ``TFOS_DECODE_QUEUE_MAX`` outstanding sessions, raises
@@ -285,6 +297,16 @@ class Server:
         if self.spec.decode is None:
             raise RuntimeError("spec has no decode engine; pass "
                                "ModelSpec(..., decode=DecodeSpec(...))")
+        prompt = [int(t) for t in prompt]
+        max_seq = self.spec.decode.cfg.max_seq
+        if not prompt or len(prompt) > max_seq - 1:
+            raise ValueError(
+                f"prompt length {len(prompt)} not in [1, {max_seq - 1}] "
+                f"(max_seq {max_seq})")
+        if seed is None and temperature is not None and temperature > 0:
+            seed = random.getrandbits(31)
+        sampling = _sampling.make(temperature=temperature, top_k=top_k,
+                                  top_p=top_p, seed=seed)
         depth = self.pool.outstanding_sessions()
         if depth >= self.decode_queue_max:
             self.decode_stats.observe_shed()
@@ -297,7 +319,8 @@ class Server:
             max_tokens or (self.spec.decode.max_tokens
                            if self.spec.decode else None)
             or _decode.max_tokens_default(),
-            self.spec.decode.eos_id if eos_id is None else eos_id)
+            self.spec.decode.eos_id if eos_id is None else eos_id,
+            sampling=sampling)
         self.pool.dispatch_session(session)
         try:
             out = session.result(timeout or self.request_timeout)
@@ -348,9 +371,12 @@ class Client:
     def predict(self, example, timeout=None):
         return self._server.predict(example, timeout=timeout)
 
-    def generate(self, prompt, max_tokens=None, eos_id=None, timeout=None):
+    def generate(self, prompt, max_tokens=None, eos_id=None, timeout=None,
+                 temperature=None, top_k=None, top_p=None, seed=None):
         return self._server.generate(prompt, max_tokens=max_tokens,
-                                     eos_id=eos_id, timeout=timeout)
+                                     eos_id=eos_id, timeout=timeout,
+                                     temperature=temperature, top_k=top_k,
+                                     top_p=top_p, seed=seed)
 
 
 # ---------------------------------------------------------------------------
@@ -423,7 +449,10 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _do_generate(self, srv):
         """POST /v1/generate: ``{"prompt": [ids], "max_tokens"?,
-        "eos_id"?}`` -> the session result dict (docs/serving.md)."""
+        "eos_id"?, "temperature"?, "top_k"?, "top_p"?, "seed"?}`` ->
+        the session result dict (docs/serving.md).  Oversized prompts
+        and out-of-range sampling knobs are client errors (400), never
+        replica-side crashes."""
         try:
             length = int(self.headers.get("Content-Length", 0))
             payload = json.loads(self.rfile.read(length) or b"{}")
@@ -438,7 +467,15 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             out = srv.generate(prompt,
                                max_tokens=payload.get("max_tokens"),
-                               eos_id=payload.get("eos_id"))
+                               eos_id=payload.get("eos_id"),
+                               temperature=payload.get("temperature"),
+                               top_k=payload.get("top_k"),
+                               top_p=payload.get("top_p"),
+                               seed=payload.get("seed"))
+        except ValueError as e:
+            # oversized/empty prompt, bad sampling range: client error
+            self._reply(400, {"error": str(e)})
+            return
         except Overloaded as e:
             self._reply(503, {"error": "overloaded",
                               "retry_after": round(e.retry_after, 3)},

@@ -131,6 +131,15 @@ CATALOG = {
                  "iteration."),
     "tfos_decode_retired_total": (
         "counter", "Decode sessions retired (EOS or max_tokens)."),
+    "tfos_decode_prefix_hits": (
+        "counter", "Admissions that mapped trie-matched prompt-prefix "
+                   "blocks instead of re-prefilling them."),
+    "tfos_decode_blocks_in_use": (
+        "gauge", "Paged-KV blocks referenced by live sessions or the "
+                 "prefix trie (sentinel excluded)."),
+    "tfos_decode_spec_accept": (
+        "gauge", "Speculative-decode draft acceptance rate (accepted / "
+                 "proposed, cumulative)."),
     # checkpoint (any process)
     "tfos_checkpoint_saves_total": (
         "counter", "Checkpoint saves completed."),

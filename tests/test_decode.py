@@ -306,7 +306,8 @@ def test_server_generate_and_http_roundtrip(tmp_path):
 class _GenShedStub:
     pool = None
 
-    def generate(self, prompt, max_tokens=None, eos_id=None, timeout=None):
+    def generate(self, prompt, max_tokens=None, eos_id=None, timeout=None,
+                 **sampling_kw):
         raise B.Overloaded(65, 64, retry_after=0.5)
 
 
