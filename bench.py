@@ -1228,6 +1228,13 @@ def _decode_bench(dev, on_tpu):
     aggregate tokens/s, the three SLO numbers docs/serving.md defines
     for the decode tier.
 
+    TFOS_BENCH_DECODE_PREFIX (default 0.6) is the fraction of sessions
+    that share one of a small pool of long system prompts; the lane runs
+    a second arm with prefix sharing disabled on the same trace and
+    reports its TTFT p50 as ``nosharing_ttft_p50_ms`` (the paged-cache
+    win is TTFT p50 strictly below that arm plus a nonzero
+    ``prefix_hit_rate`` / ``prefill_tokens_saved``).
+
     Like the serve lane, replicas are FORCED onto CPU: the main bench
     process may hold the (serialized) TPU claim.
     """
@@ -1238,7 +1245,8 @@ def _decode_bench(dev, on_tpu):
 
     from tensorflowonspark_tpu import serving
     from tensorflowonspark_tpu.models import transformer as _tfm
-    from tensorflowonspark_tpu.serving.decode import run_open_loop
+    from tensorflowonspark_tpu.serving.decode import (run_open_loop,
+                                                      shared_prefix_prompts)
     from tensorflowonspark_tpu.utils import checkpoint as ckpt
 
     replicas = int(os.environ.get("TFOS_BENCH_DECODE_REPLICAS", "2"))
@@ -1246,6 +1254,7 @@ def _decode_bench(dev, on_tpu):
     n_sessions = int(os.environ.get("TFOS_BENCH_DECODE_N", "24"))
     rate_rps = float(os.environ.get("TFOS_BENCH_DECODE_RPS", "4"))
     max_tokens = int(os.environ.get("TFOS_BENCH_DECODE_TOKENS", "16"))
+    prefix_frac = float(os.environ.get("TFOS_BENCH_DECODE_PREFIX", "0.6"))
     cfg = _tfm.Config(vocab_size=512, dim=128, n_layers=2, n_heads=4,
                       max_seq=128, dtype="float32", attn_impl="reference")
     tmp = tempfile.mkdtemp(prefix="tfos_bench_decode_")
@@ -1253,34 +1262,61 @@ def _decode_bench(dev, on_tpu):
         params = _tfm.init(jax.random.PRNGKey(0), cfg)
         export = os.path.join(tmp, "export")
         ckpt.export_model(export, params, metadata={})
-        spec = serving.ModelSpec(
-            export_dir=export,
-            decode=serving.DecodeSpec(cfg, slots=slots,
-                                      max_tokens=max_tokens))
-        rng = np.random.default_rng(0)
-        prompts = [rng.integers(0, cfg.vocab_size, size=n).tolist()
-                   for n in rng.integers(4, 33, size=n_sessions)]
+        prompts, pool = shared_prefix_prompts(
+            n_sessions, vocab_size=cfg.vocab_size,
+            prefix_frac=prefix_frac, seed=0)
+        warm = pool[0] + prompts[0][-4:]
+        # same pool prefix, full-width tail: compiles the trie-matched
+        # extend bucket (tail bucket 16, 4 shared blocks) the measured
+        # shared sessions land in
+        warm_tail = pool[0] + pool[1][:16]
 
-        with serving.Server(
-            spec, num_replicas=replicas, request_timeout=300,
-            env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""},
-        ) as srv:
-            # warmup: pay jax import + prefill/decode_step compiles on
-            # every replica before the clock starts
-            for _ in range(replicas):
-                srv.generate(prompts[0], max_tokens=2, timeout=300)
+        def _prefix_stats(srv):
+            out = {"prefix_hits": 0, "prefix_tokens_saved": 0}
+            for rep in srv.summary(
+                    include_replicas=True)["replica_stats"].values():
+                d = (rep or {}).get("decode") or {}
+                for k in out:
+                    out[k] += int(d.get(k) or 0)
+            return out
 
-            def session(i):
-                out = srv.generate(prompts[i % len(prompts)],
-                                   max_tokens=max_tokens, timeout=300)
-                return {"ttft_ms": out.get("ttft_ms"),
-                        "token_ms": out.get("token_ms"),
-                        "tokens": len(out.get("tokens") or ())}
+        def _arm(sharing):
+            spec = serving.ModelSpec(
+                export_dir=export,
+                decode=serving.DecodeSpec(cfg, slots=slots,
+                                          max_tokens=max_tokens,
+                                          prefix_sharing=sharing))
+            with serving.Server(
+                spec, num_replicas=replicas, request_timeout=300,
+                env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""},
+            ) as srv:
+                # warmup: pay jax import + prefill/decode_step compiles
+                # on every replica before the clock starts; two
+                # same-prefix generations per replica also seed the trie
+                # and warm the matched extend path when sharing is on
+                for _ in range(2 * replicas):
+                    srv.generate(warm, max_tokens=2, timeout=300)
+                for _ in range(replicas):
+                    srv.generate(warm_tail, max_tokens=2, timeout=300)
+                base = _prefix_stats(srv)
 
-            stats = run_open_loop(session, rate_rps=rate_rps,
-                                  n_requests=n_sessions, seed=0,
-                                  shed_exc=serving.Overloaded)
+                def session(i):
+                    out = srv.generate(prompts[i % len(prompts)],
+                                       max_tokens=max_tokens, timeout=300)
+                    return {"ttft_ms": out.get("ttft_ms"),
+                            "token_ms": out.get("token_ms"),
+                            "tokens": len(out.get("tokens") or ())}
 
+                stats = run_open_loop(session, rate_rps=rate_rps,
+                                      n_requests=n_sessions, seed=0,
+                                      shed_exc=serving.Overloaded)
+                after = _prefix_stats(srv)
+            return stats, {k: after[k] - base[k] for k in after}
+
+        stats, pstats = _arm(True)
+        nosharing, _ = _arm(False)
+
+        completed = max(1, stats["completed"])
         return {
             "sessions": stats["requests"],
             "completed": stats["completed"],
@@ -1293,6 +1329,12 @@ def _decode_bench(dev, on_tpu):
             "ttft_p99_ms": stats.get("ttft_p99_ms"),
             "tok_p50_ms": stats.get("tok_p50_ms"),
             "tok_p99_ms": stats.get("tok_p99_ms"),
+            "prefix_frac": prefix_frac,
+            "prefix_hits": pstats["prefix_hits"],
+            "prefix_hit_rate": round(
+                pstats["prefix_hits"] / completed, 4),
+            "prefill_tokens_saved": pstats["prefix_tokens_saved"],
+            "nosharing_ttft_p50_ms": nosharing.get("ttft_p50_ms"),
             "replicas": replicas,
             "slots": slots,
         }

@@ -8,6 +8,11 @@ then demonstrates
 
 - ``Server.generate``: one decode session, token-identical to a
   full-recompute greedy decode (the KV cache changes the math zero),
+- seeded sampling: ``temperature=/top_k=/top_p=/seed=`` — every token
+  is a pure function of (logits, seed, index), so the same seed
+  reproduces the same stream (the property failover replay relies on),
+- prefix sharing: sessions repeating a system prompt map the already-
+  computed KV blocks instead of re-prefilling them (paged cache),
 - continuous batching: concurrent mixed-length sessions share the
   replica's KV slots, newcomers admitted between decode steps,
 - the open-loop load generator (``serving.run_open_loop``) reporting
@@ -80,6 +85,28 @@ def main():
         print(f"single session: {len(out['tokens'])} tokens, "
               f"ttft {out['ttft_ms']:.1f} ms — token-identical to "
               "full-recompute greedy decode")
+
+        a = srv.generate(prompts[0], max_tokens=args.max_tokens,
+                         temperature=0.8, top_k=40, seed=1234,
+                         timeout=300)
+        b = srv.generate(prompts[0], max_tokens=args.max_tokens,
+                         temperature=0.8, top_k=40, seed=1234,
+                         timeout=300)
+        assert a["tokens"] == b["tokens"], "seeded sampling not reproducible"
+        print(f"seeded sampling (T=0.8 top_k=40 seed=1234): "
+              f"{a['tokens'][:8]}... — same seed, same stream")
+
+        # same system prompt, different tails: followers map the shared
+        # prefix blocks instead of re-prefilling them
+        system = prompts[0][:16] if len(prompts[0]) >= 16 else prompts[0]
+        for tail in ([7, 3, 9], [11, 2, 5], [4, 8, 6]):
+            srv.generate(system * 2 + tail, max_tokens=4, timeout=300)
+        reps = srv.summary(include_replicas=True)["replica_stats"]
+        hits = sum(int(((r or {}).get("decode") or {}).get(
+            "prefix_hits") or 0) for r in reps.values())
+        saved = sum(int(((r or {}).get("decode") or {}).get(
+            "prefix_tokens_saved") or 0) for r in reps.values())
+        print(f"prefix sharing: hits={hits} prefill_tokens_saved={saved}")
 
         def session(i):
             o = srv.generate(prompts[i % len(prompts)],

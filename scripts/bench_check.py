@@ -64,8 +64,13 @@ LANES = (
     ("serve.req_s", ("extra", "serve", "req_per_sec"), True),
     ("serve.p99_ms", ("extra", "serve", "p99_ms"), False),
     ("decode.tok_s", ("extra", "decode", "tokens_per_sec"), True),
+    ("decode.ttft_p50_ms", ("extra", "decode", "ttft_p50_ms"), False),
     ("decode.ttft_p99_ms", ("extra", "decode", "ttft_p99_ms"), False),
     ("decode.tok_p99_ms", ("extra", "decode", "tok_p99_ms"), False),
+    ("decode.prefix_hit_rate",
+     ("extra", "decode", "prefix_hit_rate"), True),
+    ("decode.prefill_tok_saved",
+     ("extra", "decode", "prefill_tokens_saved"), True),
     ("elastic.resize_ms", ("extra", "elastic", "resize_ms"), False),
     ("elastic.reshard_ms", ("extra", "elastic", "reshard_ms"), False),
     ("actors.ask_p50_ms", ("extra", "actors", "ask_p50_ms"), False),

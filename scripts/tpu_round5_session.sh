@@ -60,6 +60,7 @@ else
   # to skip
   TFOS_BENCH_SERVE="${TFOS_BENCH_SERVE:-1}" \
   TFOS_BENCH_DECODE="${TFOS_BENCH_DECODE:-1}" \
+  TFOS_BENCH_DECODE_PREFIX="${TFOS_BENCH_DECODE_PREFIX:-0.6}" \
     session_run 7200 bash -c 'python bench.py > BENCH_session_r5.json.tmp \
     && mv BENCH_session_r5.json.tmp BENCH_session_r5.json \
     && cat BENCH_session_r5.json'
@@ -102,6 +103,7 @@ if [ "$smoke" = "1" ]; then
 else
   TFOS_BENCH_SERVE="${TFOS_BENCH_SERVE:-1}" \
   TFOS_BENCH_DECODE="${TFOS_BENCH_DECODE:-1}" \
+  TFOS_BENCH_DECODE_PREFIX="${TFOS_BENCH_DECODE_PREFIX:-0.6}" \
     session_run 7200 bash -c 'python bench.py > BENCH_session_r5_final.json.tmp \
     && mv BENCH_session_r5_final.json.tmp BENCH_session_r5_final.json \
     && cat BENCH_session_r5_final.json'
