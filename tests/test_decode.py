@@ -1,10 +1,11 @@
-"""Decode-tier tests: slot-paged KV cache units, sequence-length
-bucketing, the open-loop load generator, the CPU parity acceptance gate
-(continuous-batched greedy decode token-identical to full-recompute,
-including mid-flight admission of staggered mixed-length prompts), and
-the Server/HTTP generate surface.  Slow lane: a replica SIGKILLed
-mid-decode (sessions re-prefill on the survivor; zero dropped and zero
-duplicated tokens)."""
+"""Decode-tier tests: slot- and block-paged KV cache units (refcount
+lint, prefix-trie match/reclaim), sequence-length bucketing, the
+open-loop load generator, seeded sampling, the CPU parity acceptance
+gates (paged == slot-paged == full-recompute greedy; seeded-sampling
+replay token-identical; speculative == non-speculative at the same
+seed), and the Server/HTTP generate surface incl. oversized-prompt
+400s.  Slow lane: a replica SIGKILLed mid-decode (sessions re-prefill
+on the survivor; zero dropped and zero duplicated tokens)."""
 
 import functools
 import json
@@ -156,6 +157,97 @@ def test_kvcache_slot_lifecycle_and_insert():
     assert cache.alloc() == 1  # freed slot is reusable
 
 
+def test_paged_kvcache_lifecycle_refcounts_and_prefix_match():
+    from tensorflowonspark_tpu.serving.decode import kvcache
+    cfg = _cfg()
+    cache = kvcache.PagedKVCache(cfg, slots=2, block_size=4)
+    hd = cfg.dim // cfg.n_heads
+    assert cache.k.shape == (cache.num_blocks, cfg.n_layers, cfg.n_heads,
+                             4, hd)
+    assert cache.blocks_per_slot == 8  # ceil(32 / 4)
+    assert cache.blocks_in_use == 0 and cache.leaked_blocks() == []
+
+    prompt = list(range(1, 11))  # 10 tokens -> 2 whole blocks + tail
+    assert cache.match_prefix(prompt) == ([], 0)  # cold trie
+    slot = cache.alloc()
+    own = cache.alloc_blocks(3)
+    assert 0 not in own  # the sentinel is never handed out
+    cache.map_session(slot, [], own, 10)
+    k = np.zeros((cfg.n_layers, cfg.n_heads, 10, hd), np.float32)
+    cache.insert_tail(slot, k, k, 0, 10)
+    cache.register_prompt(slot, prompt)
+    assert cache.blocks_in_use == 3 and cache.leaked_blocks() == []
+
+    # a follower matches whole blocks only, capped one token short of
+    # the full prompt so admission always has a real tail to prefill
+    shared, mtoks = cache.match_prefix(prompt)
+    assert mtoks == 8 and shared == own[:2]
+    slot2 = cache.alloc()
+    own2 = cache.alloc_blocks(1)
+    cache.map_session(slot2, shared, own2, 10)
+    assert cache.blocks_in_use == 4  # leader's 3 + follower's tail block
+    assert cache.leaked_blocks() == []
+
+    # tail writes must start block-aligned (copy-on-write contract)
+    with pytest.raises(ValueError):
+        cache.insert_tail(slot2, k, k, 9, 1)
+
+    # retiring both sessions keeps the registered prefix trie-resident
+    cache.retire(slot)
+    cache.retire(slot2)
+    assert cache.occupancy == 0
+    assert cache.blocks_in_use == 2  # the two whole-prefix blocks
+    assert cache.leaked_blocks() == []
+    assert cache.match_prefix(prompt)[1] == 8  # still a hit
+
+
+def test_paged_kvcache_trie_reclaim_lru_and_oom():
+    from tensorflowonspark_tpu.serving.decode import kvcache
+    cfg = _cfg()
+    with pytest.raises(ValueError):
+        # below sentinel + slots*blocks_per_slot: live sessions starve
+        kvcache.PagedKVCache(cfg, slots=1, block_size=4, num_blocks=8)
+    cache = kvcache.PagedKVCache(cfg, slots=1, block_size=4, num_blocks=9)
+    prompt = list(range(1, 11))
+    slot = cache.alloc()
+    cache.map_session(slot, [], cache.alloc_blocks(3), 10)
+    cache.register_prompt(slot, prompt)
+    cache.retire(slot)
+    assert cache.blocks_in_use == 2  # trie-only now
+
+    # one block over the free list: the LRU *leaf* is evicted, the
+    # parent (shorter prefix) stays matchable
+    got = cache.alloc_blocks(7)
+    assert cache.match_prefix(prompt)[1] == 4
+    # live references hold everything else: reclaim can't satisfy this
+    with pytest.raises(kvcache.CacheOOM):
+        cache.alloc_blocks(2)
+    # ... but the attempt drained the remaining (fully freed) trie path
+    assert cache.match_prefix(prompt) == ([], 0)
+    for b in got:
+        cache._release(b)
+    assert cache.blocks_in_use == 0 and cache.leaked_blocks() == []
+
+
+def test_sampling_make_validation_and_pure_function():
+    from tensorflowonspark_tpu.serving.decode import sampling
+    assert sampling.make() is None
+    assert sampling.make(temperature=0.0, top_k=5, seed=3) is None  # greedy
+    for bad in (dict(temperature=-0.5), dict(temperature=1.0, top_k=0),
+                dict(temperature=1.0, top_p=0.0),
+                dict(temperature=1.0, top_p=1.5)):
+        with pytest.raises(ValueError):
+            sampling.make(**bad)
+    logits = np.random.default_rng(0).normal(size=61)
+    assert sampling.sample_token(logits, None, 4) == int(np.argmax(logits))
+    p = sampling.make(temperature=0.8, top_k=12, top_p=0.9, seed=42)
+    a = [sampling.sample_token(logits, p, i) for i in range(16)]
+    b = [sampling.sample_token(logits, p, i) for i in range(16)]
+    assert a == b  # pure in (logits, params, index): replayable
+    p2 = sampling.make(temperature=0.8, top_k=12, top_p=0.9, seed=43)
+    assert [sampling.sample_token(logits, p2, i) for i in range(16)] != a
+
+
 def test_engine_submit_rejects_bad_prompts_via_emit():
     events = []
     cfg = _cfg()
@@ -252,6 +344,140 @@ def test_parity_eos_stops_early():
     assert events["done"][0][0] == ref
 
 
+def _run_sessions(params, spec, jobs, timeout=300):
+    """Drive a DecodeEngine over ``jobs`` = [(sid, prompt, submit_kw)];
+    returns ({sid: tokens}, stats, engine) — stats captured before
+    stop, the (stopped) engine returned for cache introspection."""
+    events = {sid: {"done": None, "error": None} for sid, _, _ in jobs}
+    lock = threading.Lock()
+
+    def emit(kind, sid, *rest):
+        with lock:
+            if kind == "done":
+                events[sid]["done"] = rest[0]
+            elif kind == "error":
+                events[sid]["error"] = rest[0]
+
+    eng = D.DecodeEngine(params, spec, emit)
+    eng.start(timeout=timeout)
+    try:
+        for sid, prompt, kw in jobs:
+            eng.submit(sid, prompt, **kw)
+        deadline = time.time() + timeout
+        while (any(e["done"] is None and e["error"] is None
+                   for e in events.values()) and time.time() < deadline):
+            time.sleep(0.01)
+        stats = eng.stats()
+    finally:
+        eng.stop()
+    for sid, ev in events.items():
+        assert ev["error"] is None, (sid, ev["error"])
+        assert ev["done"] is not None, (sid, "timed out")
+    return {sid: ev["done"] for sid, ev in events.items()}, stats, eng
+
+
+def test_parity_paged_equals_slot_equals_oracle_with_prefix_hits():
+    """Gate (a): block-paged greedy decode — including trie-matched
+    admissions that skip the shared prefill — is token-identical to the
+    legacy slot-paged cache AND to a full-recompute greedy decode; the
+    engine's paged cache leaks zero block references afterwards."""
+    cfg = _cfg()
+    params = _params(cfg)
+    rng = np.random.default_rng(5)
+    system = rng.integers(1, cfg.vocab_size, size=8).tolist()
+    prompts = {"lead": system + [3, 5],
+               "follow": system + [7, 11, 13],
+               "cold": rng.integers(1, cfg.vocab_size, size=6).tolist()}
+    jobs = [(sid, p, {}) for sid, p in prompts.items()]
+    # slots=1 serializes admission, so "follow" provably arrives after
+    # "lead" registered the shared prefix -> a guaranteed trie hit
+    paged, pstats, eng = _run_sessions(
+        params, D.DecodeSpec(cfg, slots=1, max_tokens=6, paged=True,
+                             block_size=4), jobs)
+    slotted, sstats, _ = _run_sessions(
+        params, D.DecodeSpec(cfg, slots=1, max_tokens=6, paged=False),
+        jobs)
+    for sid, p in prompts.items():
+        ref = _oracle(params, p, cfg, max_tokens=6)
+        assert paged[sid] == ref, (sid, paged[sid], ref)
+        assert slotted[sid] == ref, (sid, slotted[sid], ref)
+    assert pstats["paged"] is True and sstats["paged"] is False
+    assert pstats["prefix_hits"] >= 1
+    assert pstats["prefix_tokens_saved"] >= 8  # the whole system prompt
+    # refcount lint: every retired session returned its blocks; only
+    # trie-resident prefixes (reusable capacity) remain accounted
+    cache = eng._cache
+    assert cache.occupancy == 0
+    assert cache.leaked_blocks() == []
+
+
+def test_parity_seeded_sampling_replay_token_identical():
+    """Gate (b): a seeded-sampled session replayed from scratch (what
+    failover does after re-prefill) emits the identical token stream;
+    a different seed provably diverges."""
+    from tensorflowonspark_tpu.serving.decode import sampling
+    cfg = _cfg()
+    params = _params(cfg)
+    prompt = [2, 3, 5, 7, 11]
+    sp = sampling.make(temperature=0.8, top_k=12, seed=99)
+    first, _, _ = _run_sessions(
+        params, D.DecodeSpec(cfg, slots=2, max_tokens=8, block_size=4),
+        [("r1", prompt, {"sampling": sp})])
+    replay, _, _ = _run_sessions(
+        params, D.DecodeSpec(cfg, slots=2, max_tokens=8, block_size=4),
+        [("r2", prompt, {"sampling": sp})])
+    assert first["r1"] == replay["r2"]
+    other, _, _ = _run_sessions(
+        params, D.DecodeSpec(cfg, slots=2, max_tokens=8, block_size=4),
+        [("r3", prompt,
+          {"sampling": sampling.make(temperature=0.8, top_k=12,
+                                     seed=100)})])
+    assert other["r3"] != first["r1"]
+
+
+def test_parity_speculative_equals_plain_same_seed():
+    """Gate (c): speculative decoding (draft proposes, target verifies
+    in one windowed step) returns token-identical output to the
+    non-speculative engine for greedy AND seeded-sampled sessions; a
+    draft that IS the target is always accepted (the speedup path)."""
+    import jax
+
+    from tensorflowonspark_tpu.models import transformer as T
+    from tensorflowonspark_tpu.serving.decode import sampling
+
+    cfg = _cfg()
+    params = _params(cfg)
+    dcfg = _cfg(dim=16, n_layers=1)
+    dparams = T.init(jax.random.PRNGKey(7), dcfg)
+    sp = sampling.make(temperature=0.9, top_k=16, seed=7)
+    jobs = [("g", [3, 5, 7, 9, 11], {}),
+            ("s", [4, 6, 8, 10], {"sampling": sp})]
+    plain, _, _ = _run_sessions(
+        params, D.DecodeSpec(cfg, slots=2, max_tokens=7, block_size=4),
+        jobs)
+    assert plain["g"] == _oracle(params, [3, 5, 7, 9, 11], cfg,
+                                 max_tokens=7)
+    specd, st, _ = _run_sessions(
+        params, D.DecodeSpec(cfg, slots=2, max_tokens=7, block_size=4,
+                             draft_params=dparams, draft_cfg=dcfg,
+                             spec_window=3), jobs)
+    assert specd == plain
+    assert st["spec_proposed"] > 0
+    # perfect draft (the target itself): every proposal accepted, output
+    # still identical — multiple tokens really do land per fused step
+    perfect, pt, _ = _run_sessions(
+        params, D.DecodeSpec(cfg, slots=2, max_tokens=7, block_size=4,
+                             draft_params=params, draft_cfg=cfg,
+                             spec_window=3), jobs)
+    assert perfect == plain
+    assert pt["spec_accepted"] == pt["spec_proposed"] > 0
+    with pytest.raises(ValueError):
+        D.DecodeSpec(cfg, draft_params=dparams, draft_cfg=None)
+    with pytest.raises(ValueError):
+        D.DecodeSpec(cfg, paged=False, draft_params=dparams,
+                     draft_cfg=dcfg)
+
+
 # --- Server / HTTP e2e ------------------------------------------------------
 
 def test_server_generate_and_http_roundtrip(tmp_path):
@@ -276,6 +502,17 @@ def test_server_generate_and_http_roundtrip(tmp_path):
         # predict on a decode-only spec is a clear error, not a hang
         with pytest.raises(Exception):
             srv.predict({"x": np.ones(1)}, timeout=30)
+        # oversized prompts are rejected driver-side before any replica
+        # sees the session (no crash, no shed)
+        with pytest.raises(ValueError):
+            srv.generate(list(range(1, cfg.max_seq + 1)), max_tokens=2,
+                         timeout=30)
+        # seeded sampling through the full server stack is replayable
+        s1 = srv.generate(prompt, max_tokens=6, timeout=300,
+                          temperature=0.9, top_k=8, seed=5)
+        s2 = srv.generate(prompt, max_tokens=6, timeout=300,
+                          temperature=0.9, top_k=8, seed=5)
+        assert s1["tokens"] == s2["tokens"]
         httpd = S.serve_http(srv, port=0, block=False)
         try:
             host, port = httpd.server_address
@@ -296,6 +533,16 @@ def test_server_generate_and_http_roundtrip(tmp_path):
             with pytest.raises(urllib.error.HTTPError) as ei:
                 urllib.request.urlopen(bad, timeout=30)
             assert ei.value.code == 400
+            # oversized prompt / invalid sampling params -> 400 too
+            for body in ({"prompt": list(range(1, cfg.max_seq + 1))},
+                         {"prompt": prompt, "temperature": -1.0}):
+                r400 = urllib.request.Request(
+                    f"http://{host}:{port}/v1/generate",
+                    data=json.dumps(body).encode(),
+                    headers={"Content-Type": "application/json"})
+                with pytest.raises(urllib.error.HTTPError) as ei2:
+                    urllib.request.urlopen(r400, timeout=30)
+                assert ei2.value.code == 400, body
         finally:
             httpd.shutdown()
         summ = srv.summary()
