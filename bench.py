@@ -350,6 +350,23 @@ def bench_config_path():
         os.path.dirname(os.path.abspath(__file__)), "bench_config.json")
 
 
+_RUN_ID = None
+
+
+def run_stamp():
+    """Identity keys stamped onto THE one JSON line: a per-run id plus
+    the telemetry sink it spooled to (null when telemetry was off), so a
+    bench artifact can be joined to its trace spool after the fact.
+    bench_check reads only the lane paths it names and ignores unknown
+    top-level keys, so the stamp is compare-safe (tested in
+    tests/test_obs.py)."""
+    global _RUN_ID
+    if _RUN_ID is None:
+        _RUN_ID = time.strftime("%Y%m%dT%H%M%S") + "-" + os.urandom(3).hex()
+    return {"run_id": _RUN_ID,
+            "telemetry_dir": os.environ.get(telemetry.DIR_ENV)}
+
+
 def _failsafe_line(error, **extra):
     """THE one JSON line, fail-safe form: value null + an error string.
     The driver parses the last stdout line of every round-end bench run;
@@ -368,6 +385,7 @@ def _failsafe_line(error, **extra):
         "vs_baseline": None,
         "error": error,
         "extra": extra,
+        **run_stamp(),
     }), flush=True)
 
 
@@ -847,6 +865,7 @@ def main():
         "unit": "fraction_of_peak",
         "vs_baseline": round(mfu / 0.50, 4),
         "extra": extra,
+        **run_stamp(),
     }))
 
 
@@ -1190,9 +1209,17 @@ def _serve_bench(dev, on_tpu):
             for _ in range(2):
                 client.predict({"image": images[0]}, timeout=120)
 
+            def request(i):
+                # one loadgen arrival = one trace root; the in-process
+                # client shares the arrival thread so the replica-bound
+                # serve/predict span joins this tree via the TLS stack
+                with telemetry.trace_span(telemetry.BENCH_REQUEST,
+                                          lane="serve", req=i):
+                    return client.predict(
+                        {"image": images[i % len(images)]}, timeout=120)
+
             stats = run_open_loop(
-                lambda i: client.predict(
-                    {"image": images[i % len(images)]}, timeout=120),
+                request,
                 rate_rps=rate_rps, n_requests=n_requests, seed=0,
                 shed_exc=serving.Overloaded)
             summ = srv.summary(include_replicas=True)
@@ -1301,8 +1328,11 @@ def _decode_bench(dev, on_tpu):
                 base = _prefix_stats(srv)
 
                 def session(i):
-                    out = srv.generate(prompts[i % len(prompts)],
-                                       max_tokens=max_tokens, timeout=300)
+                    with telemetry.trace_span(telemetry.BENCH_REQUEST,
+                                              lane="decode", req=i):
+                        out = srv.generate(prompts[i % len(prompts)],
+                                           max_tokens=max_tokens,
+                                           timeout=300)
                     return {"ttft_ms": out.get("ttft_ms"),
                             "token_ms": out.get("token_ms"),
                             "tokens": len(out.get("tokens") or ())}

@@ -12,7 +12,13 @@ run dirs; scanned recursively).  Output:
       percentiles, infeed-stall fraction, and MFU when the ``train/step``
       spans carry ``flops_per_item``/``peak_flops`` attrs (the counting
       convention is utils/flops.py's: 2 FLOPs/MAC — TrainMetrics attaches
-      both when constructed with a flops_per_item denominator).
+      both when constructed with a flops_per_item denominator);
+  (c) with ``--trace <id>`` (any unique prefix of a trace_id): a
+      single-request causal waterfall — every span/event carrying that
+      trace_id across every node, indented by parent link — plus a
+      critical-path summary decomposing the request into queue /
+      prefill / decode / other milliseconds (the span tree is minted by
+      ``utils/telemetry.py`` "Causal tracing").
 
 Parity: the reference has no timeline tooling at all — its observability
 is log lines (reference ``__init__.py:1-5``, SURVEY.md §5); this is the
@@ -344,6 +350,180 @@ def summarize(pairs, skipped=0):
     return "\n".join(lines) + "\n", stats
 
 
+# -- single-request causal view (--trace) ----------------------------------
+
+
+def find_trace(pairs, needle):
+    """Resolve ``needle`` (a full trace_id or any unique prefix) against
+    every record's ``attrs.trace_id``.  Returns ``(full_id, records)``
+    with the records ts-sorted; raises ``ValueError`` when nothing (or
+    more than one trace) matches."""
+    by_id = {}
+    for rec, _src in pairs:
+        tid = (rec.get("attrs") or {}).get("trace_id")
+        if tid:
+            by_id.setdefault(str(tid), []).append(rec)
+    matches = sorted(t for t in by_id if t.startswith(str(needle)))
+    if not matches:
+        raise ValueError(
+            f"no records carry trace_id {needle!r} "
+            f"({len(by_id)} distinct traces in this directory)")
+    if len(matches) > 1:
+        heads = ", ".join(m[:16] for m in matches[:6])
+        raise ValueError(
+            f"trace prefix {needle!r} is ambiguous: {heads}"
+            + ("…" if len(matches) > 6 else ""))
+    tid = matches[0]
+    return tid, sorted(by_id[tid], key=lambda r: r["ts"])
+
+
+def _span_tree(recs):
+    """(spans_by_id, children, roots, orphans) over one trace's records.
+
+    Span ``ts`` is the START time (telemetry writes at exit with the
+    entry timestamp), so tree + offsets need no reconstruction.  A span
+    whose parent_id names a span that never reached any spool (e.g. its
+    writer was SIGKILLed) is an *orphan* — reported, never dropped."""
+    spans = {}
+    for rec in recs:
+        sid = (rec.get("attrs") or {}).get("span_id")
+        if rec["kind"] == "span" and sid:
+            spans[sid] = rec
+    children = {}
+    roots, orphans = [], []
+    for sid, rec in spans.items():
+        parent = (rec.get("attrs") or {}).get("parent_id")
+        if parent and parent in spans:
+            children.setdefault(parent, []).append(sid)
+        elif parent:
+            orphans.append(sid)
+        else:
+            roots.append(sid)
+    def start(sid):
+        return spans[sid]["ts"]
+
+    for kids in children.values():
+        kids.sort(key=start)
+    roots.sort(key=start)
+    orphans.sort(key=start)
+    return spans, children, roots, orphans
+
+
+def _bar(off_ms, dur_ms, wall_ms, width=30):
+    if wall_ms <= 0:
+        return ""
+    lo = int(width * off_ms / wall_ms)
+    hi = max(lo + 1, int(width * (off_ms + dur_ms) / wall_ms))
+    return "[" + " " * lo + "#" * (hi - lo) + " " * (width - hi) + "]"
+
+
+def render_waterfall(trace_id, recs):
+    """One trace's records -> (waterfall + critical path text, stats)."""
+    spans, children, roots, orphans = _span_tree(recs)
+    events = [r for r in recs
+              if r["kind"] != "span" or not (r.get("attrs") or {}).get(
+                  "span_id")]
+    t0 = min(r["ts"] for r in recs)
+    t1 = max(r["ts"] + (r["dur_ms"] or 0.0) / 1e3 for r in recs)
+    wall = (t1 - t0) * 1e3
+    nodes = sorted({r["node_id"] for r in recs})
+    lines = [f"trace {trace_id}: {len(spans)} spans, "
+             f"{len(events)} events, {len(nodes)} nodes "
+             f"({', '.join(nodes)}), {wall:.1f}ms wall"]
+    lines.append("")
+    lines.append(f"{'offset':>9} {'dur_ms':>9} {'node':<16} span")
+
+    def emit(sid, depth):
+        rec = spans[sid]
+        off = (rec["ts"] - t0) * 1e3
+        dur = float(rec["dur_ms"] or 0.0)
+        name = ("  " * depth + ("└ " if depth else "") + rec["name"])
+        lines.append(f"{off:>9.1f} {dur:>9.1f} {rec['node_id']:<16} "
+                     f"{name:<34} {_bar(off, dur, wall)}")
+        for kid in children.get(sid, ()):
+            emit(kid, depth + 1)
+
+    for root in roots:
+        emit(root, 0)
+    for sid in orphans:
+        emit(sid, 0)
+    if orphans:
+        lines.append(f"  ({len(orphans)} span(s) whose parent never "
+                     f"reached a spool — a writer died before flush?)")
+    if events:
+        lines.append("")
+        lines.append("events:")
+        for rec in events:
+            off = (rec["ts"] - t0) * 1e3
+            hints = {k: v for k, v in (rec.get("attrs") or {}).items()
+                     if k in ("queue_ms", "sid", "slot", "reason", "depth")}
+            hint = " ".join(f"{k}={v}" for k, v in sorted(hints.items()))
+            lines.append(f"{off:>9.1f} {'·':>9} {rec['node_id']:<16} "
+                         f"{rec['name']:<34} {hint}")
+
+    # critical path: from the first root, always descend into the child
+    # that finishes last — the chain that bounded the request's latency
+    path = []
+    if roots:
+        sid = roots[0]
+        while True:
+            path.append(sid)
+            kids = children.get(sid, ())
+            if not kids:
+                break
+            sid = max(kids, key=lambda s: (spans[s]["ts"]
+                                           + (spans[s]["dur_ms"] or 0) / 1e3))
+    crit = decompose(recs, spans[roots[0]] if roots else None)
+    lines.append("")
+    lines.append(f"-- critical path ({len(path)} spans) --")
+    if path:
+        lines.append(" -> ".join(spans[s]["name"] for s in path))
+    lines.append("-- request decomposition (ms) --")
+    for k in ("queue", "prefill", "decode", "other", "total"):
+        if crit.get(k) is not None:
+            lines.append(f"{k:<8} {crit[k]:>9.1f}")
+    stats = {"trace_id": trace_id, "spans": len(spans),
+             "events": len(events), "nodes": nodes, "wall_ms": wall,
+             "orphans": len(orphans),
+             "critical_path": [spans[s]["name"] for s in path],
+             "decomposition": crit}
+    return "\n".join(lines) + "\n", stats
+
+
+def decompose(recs, root):
+    """Queue / prefill / decode / other milliseconds for one request.
+
+    queue   = decode/admit's queue_ms (driver->replica admission wait);
+    prefill = first-token latency minus the queue (ttft_ms rides
+              decode/session and serve/generate result attrs);
+    decode  = generation time (decode/retire's span duration) minus
+              prefill; ``other`` is whatever of the root span the three
+              phases don't explain: dispatch, transfer, uninstrumented.
+    Every term is None when its source attr never appeared (a predict
+    request has no decode phases)."""
+    total = float(root["dur_ms"]) if root and root["dur_ms"] else None
+    queue = ttft = gen = None
+    for rec in recs:
+        attrs = rec.get("attrs") or {}
+        if attrs.get("queue_ms") is not None and queue is None:
+            queue = float(attrs["queue_ms"])
+        if attrs.get("ttft_ms") is not None and ttft is None:
+            ttft = float(attrs["ttft_ms"])
+        if rec["name"] == "decode/retire" and rec["dur_ms"] is not None:
+            gen = float(rec["dur_ms"])
+    out = {"total": total, "queue": queue, "prefill": None,
+           "decode": None, "other": None}
+    if ttft is not None:
+        out["prefill"] = max(0.0, ttft - (queue or 0.0))
+    if gen is not None:
+        out["decode"] = max(0.0, gen - (out["prefill"] or 0.0))
+    if total is not None:
+        known = sum(v for v in (queue, out["prefill"], out["decode"])
+                    if v is not None)
+        out["other"] = max(0.0, total - known)
+    return out
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("run_dir", help="telemetry dir (run-<id>/ or the root)")
@@ -354,6 +534,10 @@ def main(argv=None):
     ap.add_argument("--summary-json", default=None, metavar="OUT",
                     help="write the summary stats (the same numbers as "
                          "the text report) as JSON for CI / bench_check")
+    ap.add_argument("--trace", default=None, metavar="TRACE_ID",
+                    help="render one request's causal waterfall + "
+                         "critical path instead of the merged summary "
+                         "(full trace_id or any unique prefix)")
     args = ap.parse_args(argv)
 
     if not os.path.isdir(args.run_dir):
@@ -363,6 +547,20 @@ def main(argv=None):
         print(f"trace_merge: no telemetry records under {args.run_dir}",
               file=sys.stderr)
         return 1
+
+    if args.trace:
+        try:
+            tid, recs = find_trace(pairs, args.trace)
+        except ValueError as e:
+            print(f"trace_merge: {e}", file=sys.stderr)
+            return 1
+        text, stats = render_waterfall(tid, recs)
+        if args.summary_json:
+            with open(args.summary_json, "w", encoding="utf-8") as f:
+                json.dump(stats, f, indent=1, sort_keys=True, default=str)
+                f.write("\n")
+        sys.stdout.write(text)
+        return 0
 
     out = args.out or os.path.join(args.run_dir, "trace.json")
     trace = to_chrome_trace(pairs)

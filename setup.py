@@ -28,6 +28,8 @@ setup(
             "tfos-serve=tensorflowonspark_tpu.serving.server:main",
             # live cluster view (docs/observability.md)
             "tfos-top=tensorflowonspark_tpu.obs.top:main",
+            # flight-recorder dump assembly (docs/telemetry.md)
+            "tfos-postmortem=tensorflowonspark_tpu.obs.postmortem:main",
         ],
     },
 )

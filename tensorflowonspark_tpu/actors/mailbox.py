@@ -13,9 +13,15 @@ Envelope grammar (plain tuples — cloudpickle-free on the control path):
 
 driver -> actor (per-member in-queue)::
 
-    ("tell", epoch, kind, blob)           one-way, no reply
-    ("ask",  epoch, req_id, kind, blob)   reply expected on the out-queue
-    ("stop",)                             drain & exit
+    ("tell", epoch, kind, blob[, trace])          one-way, no reply
+    ("ask",  epoch, req_id, kind, blob[, trace])  reply on the out-queue
+    ("stop",)                                     drain & exit
+
+``trace`` is an optional trailing W3C-traceparent string (see
+``utils/telemetry.py`` "Causal tracing"): senders stamp the active
+TraceContext so the receiver's ``actor/message`` span joins the
+originating request's tree; receivers unpack it tolerantly, so
+pre-trace senders (and re-dispatched legacy envelopes) stay valid.
 
 actor -> driver (shared group out-queue)::
 

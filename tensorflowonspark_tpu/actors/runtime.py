@@ -180,15 +180,19 @@ def _make_actor_task(actor_blob, policy_blob, group, mgr_addr, mgr_authkey):
                 if kind == "stop":
                     break
                 if kind == "tell":
-                    _, m_epoch, m_kind, blob = msg
+                    # trailing trace element is optional (mailbox.py
+                    # grammar): pre-trace senders stay valid
+                    m_epoch, m_kind, blob = msg[1], msg[2], msg[3]
+                    m_trace = msg[4] if len(msg) > 4 else None
                     if policy.epoch_fencing and m_epoch < epoch:
                         continue  # dead incarnation's inherited mail
                     try:
                         faults.check("actor.receive", group=group,
                                      actor=idx, msg=m_kind)
-                        with telemetry.span(telemetry.ACTOR_MESSAGE,
-                                            group=group, actor=idx,
-                                            kind=m_kind, ask=False):
+                        with telemetry.activate(m_trace), \
+                                telemetry.span(telemetry.ACTOR_MESSAGE,
+                                               group=group, actor=idx,
+                                               kind=m_kind, ask=False):
                             actor.on_message(ctx, m_kind,
                                              cloudpickle.loads(blob))
                     except Exception:  # noqa: BLE001 - one bad tell must
@@ -198,7 +202,8 @@ def _make_actor_task(actor_blob, policy_blob, group, mgr_addr, mgr_authkey):
                         outq.put(("event", idx, "tell_error",
                                   cloudpickle.dumps(traceback.format_exc())))
                 elif kind == "ask":
-                    _, m_epoch, req_id, m_kind, blob = msg
+                    m_epoch, req_id, m_kind, blob = msg[1:5]
+                    m_trace = msg[5] if len(msg) > 5 else None
                     if policy.epoch_fencing and m_epoch < epoch:
                         # fenced: the supervisor re-stamped and re-sent a
                         # copy; answering this one too would be harmless
@@ -207,9 +212,10 @@ def _make_actor_task(actor_blob, policy_blob, group, mgr_addr, mgr_authkey):
                     try:
                         faults.check("actor.receive", group=group,
                                      actor=idx, msg=m_kind)
-                        with telemetry.span(telemetry.ACTOR_MESSAGE,
-                                            group=group, actor=idx,
-                                            kind=m_kind, ask=True):
+                        with telemetry.activate(m_trace), \
+                                telemetry.span(telemetry.ACTOR_MESSAGE,
+                                               group=group, actor=idx,
+                                               kind=m_kind, ask=True):
                             out = actor.on_message(ctx, m_kind,
                                                    cloudpickle.loads(blob))
                         outq.put(("reply", idx, req_id, True,
@@ -352,7 +358,9 @@ class ActorGroup:
         idx = self._pick(index)
         with self._epoch_lock:
             epoch = self._epochs[idx]
-        self._send(idx, ("tell", epoch, kind, cloudpickle.dumps(payload)))
+        ctx = telemetry.current()
+        self._send(idx, ("tell", epoch, kind, cloudpickle.dumps(payload),
+                         ctx.to_header() if ctx is not None else None))
         return idx
 
     def ask(self, kind, payload=None, index=None):
@@ -361,17 +369,20 @@ class ActorGroup:
         re-stamped for its own respawn); the future resolves once."""
         self._raise_if_dead()
         blob = cloudpickle.dumps(payload)
+        ctx = telemetry.current()
+        trace = ctx.to_header() if ctx is not None else None
         with self._epoch_lock:
             self._req_counter += 1
             req_id = self._req_counter
         future = AskFuture(req_id)
         idx = self._table.add(
-            req_id, {"future": future, "kind": kind, "blob": blob},
+            req_id, {"future": future, "kind": kind, "blob": blob,
+                     "trace": trace},
             owner=(None if index is None else int(index)))
         with self._epoch_lock:
             epoch = self._epochs[idx]
         try:
-            self._send(idx, ("ask", epoch, req_id, kind, blob))
+            self._send(idx, ("ask", epoch, req_id, kind, blob, trace))
         except BaseException:
             self._table.pop(req_id)
             raise
@@ -490,6 +501,14 @@ class ActorGroup:
                                 reason=why, epoch=epoch)
                 logger.warning("actor %s[%d] lost (%s); epoch -> %d",
                                self.name, idx, why, epoch)
+                try:  # black-box flight dump (docs/telemetry.md)
+                    from tensorflowonspark_tpu.obs import flight as _flight
+
+                    _flight.snapshot(
+                        "actor/lost", node=f"{self.name}[{idx}]",
+                        reason=why, inflight=self._inflight_summary())
+                except Exception:  # noqa: BLE001 - never block failover
+                    logger.debug("flight snapshot failed", exc_info=True)
                 if "stale" in why:
                     # wedged, not dead: kill it so engine supervision
                     # respawns the slot (process death is the signal the
@@ -509,6 +528,21 @@ class ActorGroup:
                 entry["future"].reject(TimeoutError(
                     f"ask not answered within {timeout}s"))
 
+    def _inflight_summary(self, limit=32):
+        """Small-scalar view of outstanding asks for flight dumps —
+        ids, kinds and trace headers only, never payload blobs
+        (redaction contract, docs/telemetry.md "Flight recorder")."""
+        out = []
+        for req_id in list(self._table.keys())[:limit]:
+            entry = self._table.get(req_id)
+            if entry is None:
+                continue
+            item = {"req_id": req_id, "kind": str(entry.get("kind"))}
+            if entry.get("trace"):
+                item["trace"] = entry["trace"]
+            out.append(item)
+        return out
+
     def _redispatch(self, dead_idxs):
         """Re-dispatch asks owned by ``dead_idxs``: to the least-loaded
         survivor, or — when none is live — re-stamped into the dead
@@ -527,7 +561,8 @@ class ActorGroup:
                 epoch = self._epochs[idx]
             try:
                 self._inqs[idx].put(
-                    ("ask", epoch, req_id, entry["kind"], entry["blob"]))
+                    ("ask", epoch, req_id, entry["kind"], entry["blob"],
+                     entry.get("trace")))
                 moved += 1
             except Exception:  # noqa: BLE001 - manager tearing down
                 pass

@@ -635,6 +635,11 @@ def run(
         # (sparkstub / driver_ps_nodes): node_configure skips relabelling
         # when it sees role=driver.
         telemetry.configure(node_id="driver", role="driver")
+        # Root the run's causal trace: exported on TFOS_TRACE_PARENT so
+        # every later driver span, engine task and spawned node joins
+        # one tree (docs/telemetry.md "Causal tracing").
+        telemetry.trace_root(telemetry.CLUSTER_RUN,
+                             executors=num_executors)
     eng = engine_mod.as_engine(sc)
     queues = list(queues)
 

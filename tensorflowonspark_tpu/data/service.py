@@ -229,6 +229,11 @@ class DataService:
         try:
             client.partition_done(ledger_feed(self.qname, st.rank), st.unit)
             metrics_registry.inc("tfos_data_units_total")
+            # one exactly-once unit delivered; joins the run trace via
+            # the TFOS_TRACE_PARENT env the engine task exported
+            telemetry.event(telemetry.DATA_UNIT, worker=self.worker_index,
+                            trainer=st.rank, unit=st.unit,
+                            blocks=st.unit_off or self.unit_blocks)
         except Exception as e:  # noqa: BLE001 - accounting only
             logger.warning("data worker: could not record unit %d for "
                            "trainer %d: %s", st.unit, st.rank, e)

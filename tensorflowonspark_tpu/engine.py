@@ -136,11 +136,27 @@ def _executor_main(index, workdir, shared_inbox, own_inbox, results):
             os.environ["TFOS_PARTITION_INDEX"] = str(task_id)
             try:
                 faults.check("engine.task", job=job_id, task=task_id)
-                with telemetry.span("engine/task", job=job_id, task=task_id):
-                    fn, items, collect = cloudpickle.loads(blob)
-                    out = fn(iter(items))
-                    result = (list(out) if (collect and out is not None)
-                              else None)
+                fn, items, collect, trace = _unpack_task(blob)
+                # Export the dispatcher's trace context on the env
+                # channel for the task's lifetime so processes the task
+                # forks/spawns (trainers, feeders) inherit it.
+                prev_trace = os.environ.get(telemetry.TRACE_ENV)
+                if trace is not None:
+                    os.environ[telemetry.TRACE_ENV] = str(trace)
+                try:
+                    with telemetry.activate(trace), \
+                            telemetry.span("engine/task", job=job_id,
+                                           task=task_id):
+                        out = fn(iter(items))
+                        result = (list(out)
+                                  if (collect and out is not None)
+                                  else None)
+                finally:
+                    if trace is not None:
+                        if prev_trace is None:
+                            os.environ.pop(telemetry.TRACE_ENV, None)
+                        else:
+                            os.environ[telemetry.TRACE_ENV] = prev_trace
                 # Serialize the payload HERE: an unpicklable result then
                 # fails only this task (below) instead of poisoning the
                 # shared results pipe for every in-flight job.
@@ -152,6 +168,15 @@ def _executor_main(index, workdir, shared_inbox, own_inbox, results):
     finally:
         telemetry.flush()
         _reap_executor_children()
+
+
+def _unpack_task(blob):
+    """Unpack a task blob: ``(fn, items, collect)`` plus an optional
+    trailing traceparent header (older 3-tuple blobs — e.g. kept for
+    byte-identical retry re-dispatch — stay valid)."""
+    parts = cloudpickle.loads(blob)
+    trace = parts[3] if len(parts) > 3 else None
+    return parts[0], parts[1], parts[2], trace
 
 
 def _reap_executor_children():
@@ -420,6 +445,14 @@ class LocalEngine:
                 self._procs[index] = self._spawn_executor(index)
         telemetry.event("engine/executor_respawn", executor=index,
                         respawns=self._budget.used)
+        try:  # black-box flight dump (docs/telemetry.md)
+            from tensorflowonspark_tpu.obs import flight as _flight
+
+            _flight.snapshot(
+                "engine/executor_respawn", node=f"executor-{index}",
+                reason=f"respawn {self._budget.used}/{self._budget.budget}")
+        except Exception:  # noqa: BLE001 - never block a respawn
+            logger.debug("flight snapshot failed", exc_info=True)
         metrics_registry.inc("tfos_engine_respawns_total")
         if metrics_registry.enabled():
             metrics_registry.set_gauge(
@@ -546,7 +579,12 @@ class LocalEngine:
             max_retries = 0
         # Blobs are kept for the job's lifetime when retryable so a failed
         # or lost task can be re-dispatched byte-identically.
-        blobs = [cloudpickle.dumps((fn, list(part), collect))
+        # The active trace context (the engine/job span's, when a trace
+        # is live) rides each blob so executor-side task spans join the
+        # dispatching request's tree.
+        ctx = telemetry.current()
+        trace_hdr = ctx.to_header() if ctx is not None else None
+        blobs = [cloudpickle.dumps((fn, list(part), collect, trace_hdr))
                  for part, fn in tasks]
 
         def _dispatch(task_id):

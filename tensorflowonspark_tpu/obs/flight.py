@@ -1,0 +1,155 @@
+"""Black-box flight recorder: span-ring snapshots on supervision events.
+
+No reference counterpart (the reference's failure story is free-text
+log lines at node granularity, ``TFSparkNode.py:356`` / SURVEY.md §5 —
+when an executor died you got whatever stdout survived).  Here every
+process already keeps a bounded ring of its most recent telemetry
+records (``telemetry.Recorder.ring``, ``TFOS_FLIGHT_RING`` deep);
+this module freezes that ring to disk the moment supervision notices
+something died — replica lost (serving/replicas.py ``_monitor``),
+executor respawn (engine.py ``_respawn_executor``), actor lost
+(actors/runtime.py ``_monitor``), fault-site fire (utils/faults.py) —
+so the *last N seconds before the death* survive the death.
+``tfos-postmortem`` (obs/postmortem.py) assembles the dumps plus the
+telemetry spools into a "what was everyone doing" report.
+
+Contracts (ISSUE 12 satellite: bounded + redaction-safe):
+
+- **no-op when telemetry is disabled** — ``snapshot`` returns None
+  without touching the filesystem;
+- **bounded** — each dump is clipped to ``TFOS_FLIGHT_CAP`` bytes
+  (oldest ring records dropped first, drop count kept), and at most
+  ``TFOS_FLIGHT_KEEP`` dumps per process are retained (oldest deleted);
+- **redaction-safe** — record attrs and in-flight entries are
+  sanitized to small scalars before writing: no prompts, tensors,
+  pickled blobs, or strings past 200 chars ever land in a dump.
+
+Dumps are one-JSON-object files named
+``flight-<node>-<pid>-<seq>.json`` in the process's telemetry sink
+dir (the spool the driver drain already collects), so postmortem
+assembly needs no new transport.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import time
+
+from tensorflowonspark_tpu.utils import telemetry
+
+logger = logging.getLogger(__name__)
+
+PREFIX = "flight-"
+CAP_ENV = "TFOS_FLIGHT_CAP"        # max bytes per dump file
+WINDOW_ENV = "TFOS_FLIGHT_WINDOW"  # trailing seconds of ring per dump
+KEEP_ENV = "TFOS_FLIGHT_KEEP"      # dumps retained per process
+
+_MAX_STR = 200        # longest attr string kept verbatim
+_MAX_INFLIGHT = 64    # in-flight entries kept per dump
+
+
+def cap_default():
+    return int(os.environ.get(CAP_ENV, str(256 * 1024)))
+
+
+def window_default():
+    return float(os.environ.get(WINDOW_ENV, "30"))
+
+
+def keep_default():
+    return int(os.environ.get(KEEP_ENV, "8"))
+
+
+_SEQ = itertools.count(1)
+
+
+def _clean_value(v):
+    """One attr value, reduced to a small scalar (redaction contract)."""
+    if v is None or isinstance(v, (bool, int, float)):
+        return v
+    if isinstance(v, str):
+        return v if len(v) <= _MAX_STR else v[:_MAX_STR] + "…"
+    return f"<redacted {type(v).__name__}>"
+
+
+def _clean_attrs(attrs):
+    if not isinstance(attrs, dict):
+        return {}
+    return {str(k): _clean_value(v) for k, v in attrs.items()}
+
+
+def _clean_record(rec):
+    """A telemetry record with its attrs sanitized; schema unchanged."""
+    out = {k: rec.get(k) for k in telemetry.SCHEMA_KEYS}
+    out["attrs"] = _clean_attrs(rec.get("attrs"))
+    return out
+
+
+def snapshot(trigger, node=None, reason=None, inflight=None,
+             window_s=None):
+    """Freeze this process's flight ring to one bounded dump file.
+
+    ``trigger`` names the supervision event (e.g.
+    ``"serve/replica_lost"``); ``node`` the victim; ``inflight`` an
+    optional small-scalar summary of outstanding work (the caller is
+    responsible for pre-shrinking — entries are sanitized again here).
+    Returns the dump path, or None when telemetry is disabled or the
+    sink is unwritable (a flight dump must never take supervision
+    down)."""
+    rec = telemetry._get()
+    if rec is None:
+        return None
+    window = window_default() if window_s is None else float(window_s)
+    dump = {
+        "ts": time.time(),
+        "trigger": str(trigger),
+        # victim defaults to the snapshotting process itself (the
+        # faults.py self-snapshot path: the process about to die IS it)
+        "node": str(node) if node is not None else rec.node_id,
+        "reason": _clean_value(reason),
+        "recorded_by": {"node_id": rec.node_id, "role": rec.role,
+                        "pid": rec.pid},
+        "window_s": window,
+        "inflight": [_clean_attrs(e)
+                     for e in (inflight or [])[:_MAX_INFLIGHT]],
+        "truncated": 0,
+        "records": [_clean_record(r) for r in telemetry.recent(window)],
+    }
+    cap = max(cap_default(), 4096)
+    blob = json.dumps(dump, default=str)
+    while len(blob) > cap and dump["records"]:
+        drop = max(1, len(dump["records"]) // 4)  # oldest first
+        dump["records"] = dump["records"][drop:]
+        dump["truncated"] += drop
+        blob = json.dumps(dump, default=str)
+    name = (f"{PREFIX}{telemetry._safe(rec.node_id)}-{rec.pid}-"
+            f"{next(_SEQ):04d}.json")
+    path = os.path.join(rec.sink_dir, name)
+    try:
+        os.makedirs(rec.sink_dir, exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(blob + "\n")
+        _rotate(rec)
+    except OSError as e:
+        logger.warning("flight dump unwritable (%s): %s", path, e)
+        return None
+    return path
+
+
+def _rotate(rec):
+    """Keep only the newest TFOS_FLIGHT_KEEP dumps of this process."""
+    keep = max(keep_default(), 1)
+    mine = f"{PREFIX}{telemetry._safe(rec.node_id)}-{rec.pid}-"
+    try:
+        names = sorted(n for n in os.listdir(rec.sink_dir)
+                       if n.startswith(mine) and n.endswith(".json"))
+    except OSError:
+        return
+    for name in names[:-keep]:
+        try:
+            os.remove(os.path.join(rec.sink_dir, name))
+        except OSError:
+            pass

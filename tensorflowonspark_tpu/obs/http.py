@@ -1,4 +1,4 @@
-"""Driver-side observability endpoint: /metrics, /healthz, /statusz.
+"""Driver-side observability endpoint: /metrics /healthz /statusz /slo.
 
 The driver half of the live metrics plane (node half:
 ``obs/publish.py``).  ``ObsServer`` polls every cluster node's manager
@@ -12,8 +12,11 @@ driver's own registry, and serves:
   age exceeds ``manager.stale_after()``; 200 when every node is live,
   503 otherwise (load-balancer semantics).
 - ``/statusz``  JSON cluster snapshot: epoch, restart budget/used,
-  feed-ledger progress, and a per-node summary (last-seen, step rate,
-  queue depth, stall %, SLO percentiles) — what ``tfos-top`` renders.
+  feed-ledger progress, a per-node summary (last-seen, step rate,
+  queue depth, stall %, SLO percentiles) and the SLO engine's last
+  report — what ``tfos-top`` renders.
+- ``/slo``      JSON burn-rate report, re-evaluated per request
+  (``obs/slo.py``): objective, current value, burn, breaching.
 
 Gated on ``TFOS_OBS_PORT`` (no server, no threads, no polling when
 unset); port 0 binds an ephemeral port, exposed as ``server.port``.
@@ -31,6 +34,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from tensorflowonspark_tpu import manager as tfmanager
+from tensorflowonspark_tpu.obs import slo as _slo
 from tensorflowonspark_tpu.utils import metrics_registry
 
 logger = logging.getLogger(__name__)
@@ -138,6 +142,7 @@ class ObsServer:
         self._mgrs = {}    # (host, executor_id) -> manager proxy
         self._httpd = None
         self._threads = []
+        self.slo = _slo.Engine()
 
     # -- lifecycle -----------------------------------------------------
 
@@ -154,7 +159,8 @@ class ObsServer:
                              name="tfos-obs-poll", daemon=True)
         p.start()
         self._threads.append(p)
-        logger.info("obs: serving /metrics /healthz /statusz on %s", self.url)
+        logger.info("obs: serving /metrics /healthz /statusz /slo on %s",
+                    self.url)
         return self
 
     @property
@@ -238,15 +244,25 @@ class ObsServer:
                     e["heartbeat_age_s"] = hb_age
 
     def poll_once(self):
-        """One sweep over the cluster's nodes (the poll thread's body;
-        callable directly in tests)."""
+        """One sweep over the cluster's nodes, then one SLO evaluation
+        over everything the sweep (plus the driver registry) can see
+        (the poll thread's body; callable directly in tests)."""
         cluster = self.cluster
-        if cluster is None:
-            return
-        for meta in list(getattr(cluster, "cluster_info", ()) or ()):
-            if self._stop.is_set():
-                return
-            self._poll_node(meta)
+        if cluster is not None:
+            for meta in list(getattr(cluster, "cluster_info", ()) or ()):
+                if self._stop.is_set():
+                    return
+                self._poll_node(meta)
+        self.slo.step(self._all_snapshots())
+
+    def _all_snapshots(self):
+        """Every registry snapshot in view: the driver's own plus each
+        polled node's last published one (the SLO evaluation input)."""
+        snaps = [metrics_registry.snapshot()]
+        for ent in self._node_entries().values():
+            if ent.get("metrics"):
+                snaps.append(ent["metrics"])
+        return [s for s in snaps if s]
 
     def _poll_loop(self):
         while not self._stop.is_set():
@@ -329,6 +345,9 @@ class ObsServer:
                 "summary": node_summary(driver),
             }
         out["nodes"] = nodes
+        rep = self.slo.report()
+        if rep.get("objectives"):
+            out["slo"] = rep["objectives"]
         # Supervised-actor table: one row per member of every live
         # ActorSystem in this process (lazy import: obs has no actor
         # dependency unless someone spawned one).
@@ -341,6 +360,12 @@ class ObsServer:
         if rows:
             out["actors"] = rows
         return out
+
+    def render_slo(self):
+        """Fresh objective evaluation over everything in view (the
+        ``/slo`` body) — re-evaluated per request so a probe sees
+        current burn without waiting a poll interval."""
+        return self.slo.step(self._all_snapshots())
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -372,8 +397,12 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/statusz":
                 self._reply(200, json.dumps(obs.render_statusz(), indent=1),
                             "application/json")
+            elif path == "/slo":
+                self._reply(200, json.dumps(obs.render_slo(), indent=1),
+                            "application/json")
             else:
-                self._reply(404, "not found: try /metrics /healthz /statusz",
+                self._reply(404, "not found: try /metrics /healthz "
+                                 "/statusz /slo",
                             "text/plain")
         except Exception as e:  # noqa: BLE001 - never kill the server
             self._reply(500, f"obs error: {e}", "text/plain")

@@ -1,0 +1,175 @@
+"""``tfos-postmortem``: assemble flight dumps into a death timeline.
+
+No reference counterpart (the reference's postmortem workflow is
+grepping executor stdout, SURVEY.md §5).  This tool answers "what was
+everyone doing in the last N seconds before worker-3 died": it walks a
+telemetry tree for ``flight-*.json`` dumps (written by
+obs/flight.py on supervision events) plus the per-process ``*.jsonl``
+spools, and renders one report per trigger — victim, reason, the
+victim's last records, the in-flight work at the moment of death, and
+a per-node activity table over the trailing window.
+
+Hardening mirrors ``telemetry.read_spool``: truncated or corrupt
+dumps (a SIGKILL can land mid-``write``) are skipped and *counted*,
+never fatal; spool lines are parsed tolerantly the same way.
+
+Usage::
+
+    tfos-postmortem --dir TELEMETRY_DIR [--window 30] [--all]
+    python -m tensorflowonspark_tpu.obs.postmortem --dir ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+from tensorflowonspark_tpu.utils import telemetry
+
+
+def load_dumps(root):
+    """(dumps oldest->newest, corrupt_count) under ``root`` (recursive).
+
+    A usable dump is one JSON object with a ``trigger`` key; anything
+    else — truncated write, garbage, wrong shape — is skipped-with-
+    count (the read_spool hardening contract)."""
+    dumps, corrupt = [], 0
+    pattern = os.path.join(root, "**", "flight-*.json")
+    for path in sorted(glob.glob(pattern, recursive=True)):
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                doc = json.load(f)
+            if not isinstance(doc, dict) or "trigger" not in doc:
+                raise ValueError("not a flight dump")
+        except (OSError, ValueError):
+            corrupt += 1
+            continue
+        doc["_path"] = path
+        dumps.append(doc)
+    dumps.sort(key=lambda d: d.get("ts") or 0.0)
+    return dumps, corrupt
+
+
+def load_spool_records(root):
+    """Every parseable telemetry record under ``root`` (recursive),
+    via the hardened ``telemetry.read_spool`` per directory."""
+    dirs = {os.path.dirname(p) for p in glob.glob(
+        os.path.join(root, "**", "*.jsonl"), recursive=True)}
+    records = []
+    for d in sorted(dirs):
+        for _name, text in telemetry.read_spool(d):
+            for line in text.splitlines():
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    records.append(rec)
+    records.sort(key=lambda r: r.get("ts") or 0.0)
+    return records
+
+
+def _fmt_ts(ts):
+    return time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(ts)) + (
+        "%.3fZ" % (ts % 1))[1:]
+
+
+def _fmt_rec(rec, t0):
+    dt = (rec.get("ts") or 0.0) - t0
+    dur = rec.get("dur_ms")
+    dur_s = f" ({dur:.1f}ms)" if isinstance(dur, (int, float)) else ""
+    attrs = rec.get("attrs") or {}
+    keys = ("trace_id", "sid", "error", "reason", "replica", "queue_ms")
+    hint = " ".join(f"{k}={attrs[k]}" for k in keys if k in attrs)
+    return (f"  {dt:+8.2f}s {rec.get('kind', '?'):<5} "
+            f"{rec.get('name', '?')}{dur_s}"
+            + (f"  [{hint}]" if hint else ""))
+
+
+def render_report(dump, records, window, out):
+    """One postmortem section for ``dump`` onto stream ``out``."""
+    t0 = dump.get("ts") or 0.0
+    victim = dump.get("node") or "<unknown>"
+    by = dump.get("recorded_by") or {}
+    print(f"POSTMORTEM  trigger={dump['trigger']}  victim={victim}  "
+          f"reason={dump.get('reason')}", file=out)
+    print(f"  at {_fmt_ts(t0)}  "
+          f"(observed by {by.get('node_id')}/{by.get('role')}, "
+          f"dump {os.path.basename(dump.get('_path', '?'))})", file=out)
+
+    inflight = dump.get("inflight") or []
+    print(f"\n  In flight at the event ({len(inflight)}):", file=out)
+    for item in inflight or [{"(none)": ""}]:
+        line = " ".join(f"{k}={v}" for k, v in item.items())
+        print(f"    {line}", file=out)
+
+    window_recs = [r for r in records
+                   if t0 - window <= (r.get("ts") or 0.0) <= t0 + 1.0]
+    nodes = {}
+    for r in window_recs:
+        nodes.setdefault(r.get("node_id", "?"), []).append(r)
+    print(f"\n  Last {window:.0f}s before the event, per node:", file=out)
+    for nid in sorted(nodes):
+        recs = nodes[nid]
+        last = recs[-1]
+        mark = "  <- victim" if nid == victim else ""
+        print(f"    {nid:<16} {len(recs):>5} records   last: "
+              f"{last.get('name', '?')} "
+              f"({(last.get('ts') or 0) - t0:+.2f}s){mark}", file=out)
+    if not nodes:
+        print("    (no spool records in the window)", file=out)
+
+    victim_recs = (nodes.get(victim)
+                   or [r for r in dump.get("records") or []
+                       if r.get("node_id") == victim])[-10:]
+    print(f"\n  {victim}'s last records:", file=out)
+    for r in victim_recs or ():
+        print(_fmt_rec(r, t0), file=out)
+    if not victim_recs:
+        print("    (none found — the ring died with the process; see "
+              "the observer's dump records above)", file=out)
+    print("", file=out)
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="tfos-postmortem",
+        description="Assemble flight-recorder dumps into a "
+                    "who-was-doing-what report",
+    )
+    p.add_argument("--dir", required=True,
+                   help="telemetry tree holding flight-*.json dumps "
+                        "and *.jsonl spools (TFOS_TELEMETRY_DIR)")
+    p.add_argument("--window", type=float, default=None,
+                   help="trailing seconds of context per report "
+                        "(default: the dump's own window)")
+    p.add_argument("--all", action="store_true",
+                   help="render every dump, not just the newest")
+    return p
+
+
+def main(argv=None, out=None):
+    out = out or sys.stdout
+    args = build_parser().parse_args(argv)
+    dumps, corrupt = load_dumps(args.dir)
+    if corrupt:
+        print(f"tfos-postmortem: skipped {corrupt} corrupt/truncated "
+              f"dump(s)", file=out)
+    if not dumps:
+        print(f"tfos-postmortem: no usable flight dumps under "
+              f"{args.dir}", file=out)
+        return 2
+    records = load_spool_records(args.dir)
+    for dump in (dumps if args.all else dumps[-1:]):
+        window = (args.window if args.window is not None
+                  else float(dump.get("window_s") or 30.0))
+        render_report(dump, records, window, out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

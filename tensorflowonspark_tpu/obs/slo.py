@@ -1,0 +1,277 @@
+"""Declarative SLOs + error-budget burn rate over the live metrics plane.
+
+No reference counterpart: the reference's only service-level signal is
+TensorBoard scalars written by user code (``TFNode.py:152`` hands back a
+summary writer; SURVEY.md §6) — nothing states an objective, so nothing
+can say how fast it is being missed.  Here objectives are declared once
+(``TFOS_SLO``, defaults below), evaluated continuously from the same
+registry snapshots the obs plane already polls out of the manager KV
+(``obs/http.py`` ``ObsServer``), and surfaced three ways:
+
+- ``tfos_slo_*`` gauges/counters in the driver registry (``/metrics``);
+- a ``slo`` section on ``/statusz`` plus a dedicated ``/slo`` endpoint;
+- the ``tfos-top --slo`` pane (obs/top.py).
+
+Objective grammar (``TFOS_SLO``; semicolon-separated)::
+
+    entry := name ":latency:" histogram "<" threshold_ms "@" good_pct
+           | name ":availability:" counter "@" good_pct
+
+``latency`` reads one histogram metric (merged across nodes) and asks
+that ``good_pct``% of observations land at or under ``threshold_ms``.
+``availability`` reads one status-labelled counter (``status="ok"`` is
+good, anything else is bad) and asks that ``good_pct``% of outcomes be
+good.  A typo'd spec fails loudly at parse and disables the engine —
+a silently-wrong SLO is worse than none.
+
+Burn rate is the standard error-budget quotient: the observed bad
+fraction divided by the allowed bad fraction (``1 - good_pct/100``).
+``burn == 1.0`` spends the budget exactly as fast as the objective
+allows; ``burn > 1`` is a breach in progress.  Breach *transitions*
+(edge-triggered, per objective) increment ``tfos_slo_breaches_total``.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+import time
+
+from tensorflowonspark_tpu.utils import metrics_registry
+
+logger = logging.getLogger(__name__)
+
+SPEC_ENV = "TFOS_SLO"
+
+#: Ships the two objectives the serving tiers document (docs/serving.md):
+#: decode TTFT p99 under 500 ms, and 99% of serve requests not shed or
+#: errored.  Override (or disable with an empty string) via TFOS_SLO.
+DEFAULT_SPEC = ("decode_ttft:latency:tfos_decode_ttft_ms<500@99;"
+                "serve_availability:availability:tfos_serve_requests_total@99")
+
+KINDS = ("latency", "availability")
+
+
+class Objective:
+    """One parsed SLO entry (see module docstring for the grammar)."""
+
+    __slots__ = ("name", "kind", "metric", "threshold_ms", "target")
+
+    def __init__(self, name, kind, metric, threshold_ms, target):
+        self.name = name
+        self.kind = kind
+        self.metric = metric
+        self.threshold_ms = threshold_ms  # None for availability
+        self.target = target              # fraction of GOOD outcomes, 0..1
+
+    def __repr__(self):
+        pct = f"{self.target * 100:g}"
+        if self.kind == "latency":
+            return (f"{self.name}:latency:{self.metric}"
+                    f"<{self.threshold_ms:g}@{pct}")
+        return f"{self.name}:availability:{self.metric}@{pct}"
+
+
+def parse_spec(spec):
+    """``TFOS_SLO`` string -> list of :class:`Objective`.
+
+    Raises ``ValueError`` on any malformed entry."""
+    objectives = []
+    for raw in str(spec or "").split(";"):
+        entry = raw.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if len(parts) != 3:
+            raise ValueError(
+                f"slo entry {entry!r}: expected name:kind:spec")
+        name, kind, rest = (p.strip() for p in parts)
+        if not name:
+            raise ValueError(f"slo entry {entry!r}: empty name")
+        if kind not in KINDS:
+            raise ValueError(f"slo entry {entry!r}: unknown kind {kind!r} "
+                             f"(valid: {', '.join(KINDS)})")
+        rest, sep, pct_s = rest.partition("@")
+        if not sep:
+            raise ValueError(f"slo entry {entry!r}: missing @good_pct")
+        try:
+            pct = float(pct_s)
+        except ValueError:
+            raise ValueError(
+                f"slo entry {entry!r}: non-numeric target {pct_s!r}"
+            ) from None
+        if not 0.0 < pct < 100.0:
+            raise ValueError(
+                f"slo entry {entry!r}: target must be in (0, 100)")
+        threshold = None
+        metric = rest.strip()
+        if kind == "latency":
+            metric, sep, thr_s = metric.partition("<")
+            if not sep:
+                raise ValueError(
+                    f"slo entry {entry!r}: latency needs metric<threshold_ms")
+            try:
+                threshold = float(thr_s)
+            except ValueError:
+                raise ValueError(
+                    f"slo entry {entry!r}: non-numeric threshold {thr_s!r}"
+                ) from None
+            metric = metric.strip()
+        if not metric:
+            raise ValueError(f"slo entry {entry!r}: empty metric name")
+        objectives.append(Objective(name, kind, metric, threshold,
+                                    pct / 100.0))
+    return objectives
+
+
+# -- snapshot math ---------------------------------------------------------
+
+
+def merge_histogram(snaps, metric):
+    """Sum one histogram metric's series across node snapshots into a
+    single series dict (the ``quantile`` input shape).  Series whose
+    bucket bounds differ from the first one seen are skipped — mixing
+    incompatible bucketings would silently corrupt the tail.  Returns
+    None when no snapshot carries the metric."""
+    merged = None
+    for snap in snaps:
+        ent = (snap or {}).get(metric)
+        for s in (ent or {}).get("series", ()):
+            if "count" not in s:
+                continue
+            bounds = list(s.get("bounds", ()))
+            if merged is None:
+                merged = {"bounds": bounds,
+                          "counts": list(s.get("counts", ())),
+                          "sum": float(s.get("sum", 0.0)),
+                          "count": int(s.get("count", 0))}
+                continue
+            if bounds != merged["bounds"]:
+                logger.debug("slo: %s series with mismatched buckets "
+                             "skipped", metric)
+                continue
+            for i, c in enumerate(s.get("counts", ())):
+                if i < len(merged["counts"]):
+                    merged["counts"][i] += c
+            merged["sum"] += float(s.get("sum", 0.0))
+            merged["count"] += int(s.get("count", 0))
+    return merged
+
+
+def fraction_over(series, threshold):
+    """Estimated fraction of a histogram's observations ABOVE
+    ``threshold`` (linear interpolation inside the containing bucket,
+    mirroring ``metrics_registry.quantile``).  The +Inf bucket counts
+    entirely as over.  None for an empty series."""
+    count = series.get("count", 0) if series else 0
+    if not count:
+        return None
+    bounds = list(series.get("bounds", ()))
+    counts = list(series.get("counts", ()))
+    cum = 0.0
+    lo = 0.0
+    for i, c in enumerate(counts):
+        hi = bounds[i] if i < len(bounds) else math.inf
+        if threshold <= hi:
+            if hi == math.inf or hi <= lo:
+                under = cum  # whole open-ended bucket counts as over
+            else:
+                under = cum + c * (threshold - lo) / (hi - lo)
+            return max(0.0, min(1.0, (count - under) / count))
+        cum += c
+        lo = hi
+    return 0.0
+
+
+def counter_outcomes(snaps, metric):
+    """(good, total) across every node's series of one status-labelled
+    counter: ``status="ok"`` (or an unlabelled series) is good."""
+    good = total = 0.0
+    for snap in snaps:
+        ent = (snap or {}).get(metric)
+        for s in (ent or {}).get("series", ()):
+            if "value" not in s:
+                continue
+            v = float(s.get("value", 0.0))
+            total += v
+            if s.get("labels", {}).get("status", "ok") == "ok":
+                good += v
+    return good, total
+
+
+def evaluate(objective, snaps):
+    """One objective against a list of registry snapshots -> report row.
+
+    ``burn``/``current`` are None until the metric has samples (an SLO
+    with no traffic is not breaching, it is unmeasured)."""
+    allowed = max(1e-9, 1.0 - objective.target)
+    row = {"name": objective.name, "kind": objective.kind,
+           "metric": objective.metric,
+           "target_pct": round(objective.target * 100.0, 4),
+           "current": None, "burn": None, "breaching": False,
+           "samples": 0}
+    if objective.kind == "latency":
+        row["threshold_ms"] = objective.threshold_ms
+        hist = merge_histogram(snaps, objective.metric)
+        over = fraction_over(hist, objective.threshold_ms)
+        if over is None:
+            return row
+        row["samples"] = hist["count"]
+        q = metrics_registry.quantile(hist, objective.target)
+        row["current"] = None if q is None else round(q, 3)
+        row["burn"] = round(over / allowed, 4)
+    else:
+        good, total = counter_outcomes(snaps, objective.metric)
+        if not total:
+            return row
+        row["samples"] = int(total)
+        row["current"] = round(good / total, 6)
+        row["burn"] = round((1.0 - good / total) / allowed, 4)
+    row["breaching"] = bool(row["burn"] is not None and row["burn"] > 1.0)
+    return row
+
+
+class Engine:
+    """Holds the parsed objectives + breach edge state; one per
+    ObsServer.  ``step`` evaluates every objective against the given
+    snapshots, publishes the ``tfos_slo_*`` series into this process's
+    registry, and caches the report for ``/statusz`` and ``/slo``."""
+
+    def __init__(self, spec=None):
+        if spec is None:
+            spec = os.environ.get(SPEC_ENV, DEFAULT_SPEC)
+        try:
+            self.objectives = parse_spec(spec)
+        except ValueError:
+            logger.exception("invalid %s=%r; slo engine disabled",
+                             SPEC_ENV, spec)
+            self.objectives = []
+        self._breaching = {}
+        self._report = {"ts": None, "objectives": []}
+
+    def step(self, snaps, emit=True):
+        rows = [evaluate(o, snaps) for o in self.objectives]
+        if emit:
+            for row in rows:
+                if row["burn"] is None:
+                    continue
+                metrics_registry.set_gauge("tfos_slo_burn_rate",
+                                           row["burn"],
+                                           objective=row["name"])
+                if row["current"] is not None:
+                    metrics_registry.set_gauge("tfos_slo_current",
+                                               row["current"],
+                                               objective=row["name"])
+                was = self._breaching.get(row["name"], False)
+                if row["breaching"] and not was:
+                    metrics_registry.inc("tfos_slo_breaches_total",
+                                         objective=row["name"])
+                self._breaching[row["name"]] = row["breaching"]
+        self._report = {"ts": time.time(), "objectives": rows}
+        return self._report
+
+    def report(self):
+        """The last computed report (never None; empty before the first
+        ``step``)."""
+        return self._report

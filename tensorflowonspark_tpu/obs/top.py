@@ -11,9 +11,11 @@ a single snapshot and exits (the form the fast-lane test drives).
 
 Usage::
 
-    tfos-top [--url http://127.0.0.1:9090] [--interval 2] [--once]
+    tfos-top [--url http://127.0.0.1:9090] [--interval 2] [--once] [--slo]
 
-``--url`` defaults to ``http://127.0.0.1:$TFOS_OBS_PORT``.
+``--url`` defaults to ``http://127.0.0.1:$TFOS_OBS_PORT``.  ``--slo``
+appends the SLO pane (one row per objective from the ``slo`` section of
+``/statusz``: tracked value, burn rate, breach flag — ``obs/slo.py``).
 """
 
 from __future__ import annotations
@@ -75,6 +77,55 @@ def _slo(summary):
     return f"{_num(p50)}/{_num(p99)}"
 
 
+SLO_COLUMNS = (
+    # (header, width, extractor) over one /statusz "slo" report row
+    ("OBJECTIVE", 20, lambda r: r.get("name", "?")),
+    ("KIND", 13, lambda r: r.get("kind", "?")),
+    ("TARGET", 8, lambda r: _slo_target(r)),
+    ("CURRENT", 10, lambda r: _slo_current(r)),
+    ("BURN", 7, lambda r: _num(r.get("burn"))),
+    ("STATE", 9, lambda r: _slo_state(r)),
+)
+
+
+def _slo_target(row):
+    pct = row.get("target_pct")
+    if pct is None:
+        return "-"
+    thr = row.get("threshold_ms")
+    return f"<{_num(thr)}ms" if thr is not None else f"{pct:g}%"
+
+
+def _slo_current(row):
+    cur = row.get("current")
+    if cur is None:
+        return "-"
+    if row.get("kind") == "latency":
+        return f"{_num(cur)}ms"
+    return _pct(cur)
+
+
+def _slo_state(row):
+    if row.get("burn") is None:
+        return "no-data"
+    return "BREACH" if row.get("breaching") else "ok"
+
+
+def render_slo(status):
+    """The --slo pane text: one row per objective, or a placeholder
+    when the driver has no SLO engine report yet."""
+    rows = status.get("slo") or []
+    lines = ["", "slo burn (obs/slo.py):"]
+    if not rows:
+        lines.append("  (no objectives reported)")
+        return "\n".join(lines) + "\n"
+    lines.append(" ".join(h.ljust(w) for h, w, _ in SLO_COLUMNS).rstrip())
+    for row in rows:
+        lines.append(" ".join(
+            str(fn(row))[:w].ljust(w) for _, w, fn in SLO_COLUMNS).rstrip())
+    return "\n".join(lines) + "\n"
+
+
 def fetch_statusz(url, timeout=5):
     """GET <url>/statusz and parse it; raises URLError/ValueError."""
     with urllib.request.urlopen(url.rstrip("/") + "/statusz",
@@ -118,6 +169,8 @@ def build_parser():
                    help="refresh period, seconds (default 2)")
     p.add_argument("--once", action="store_true",
                    help="print one snapshot and exit")
+    p.add_argument("--slo", action="store_true",
+                   help="append the SLO pane (objective, current, burn)")
     return p
 
 
@@ -142,6 +195,8 @@ def main(argv=None, out=None):
             time.sleep(args.interval)
             continue
         text = render(status)
+        if args.slo:
+            text += render_slo(status)
         if args.once:
             out.write(text)
             out.flush()

@@ -60,7 +60,7 @@ import numpy as np
 from tensorflowonspark_tpu.actors.ledger import IndexLedger, ResolveOnce
 from tensorflowonspark_tpu.serving import batcher as _batcher
 from tensorflowonspark_tpu.serving.decode import sampling as _sampling
-from tensorflowonspark_tpu.utils import metrics_registry
+from tensorflowonspark_tpu.utils import metrics_registry, telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -171,15 +171,19 @@ class PendingSession(ResolveOnce):
     """
 
     __slots__ = ("id", "prompt", "max_tokens", "eos_id", "sampling",
-                 "t_submit", "_ledger")
+                 "trace", "t_submit", "_ledger")
 
-    def __init__(self, sid, prompt, max_tokens, eos_id, sampling=None):
+    def __init__(self, sid, prompt, max_tokens, eos_id, sampling=None,
+                 trace=None):
         super().__init__()
         self.id = sid
         self.prompt = [int(t) for t in prompt]
         self.max_tokens = int(max_tokens)
         self.eos_id = eos_id
         self.sampling = sampling
+        self.trace = trace         # W3C traceparent string (or None);
+        # rides the dispatch blob so replica-side decode spans join the
+        # request's trace tree (docs/telemetry.md "Causal tracing")
         self.t_submit = time.perf_counter()
         self._ledger = IndexLedger()   # index -> token, first arrival wins
 
@@ -225,15 +229,16 @@ class _Slot:
     """Replica-side per-slot generation state."""
 
     __slots__ = ("sid", "prompt_len", "generated", "max_tokens", "eos_id",
-                 "sampling", "last", "t_admit")
+                 "sampling", "trace", "last", "t_admit")
 
     def __init__(self, sid, prompt_len, max_tokens, eos_id, first_token,
-                 sampling=None):
+                 sampling=None, trace=None):
         self.sid = sid
         self.prompt_len = prompt_len
         self.max_tokens = max_tokens
         self.eos_id = eos_id
         self.sampling = sampling
+        self.trace = trace
         self.generated = [first_token]
         self.last = first_token
         self.t_admit = time.perf_counter()
@@ -302,11 +307,13 @@ class DecodeEngine:
         self._params = params
 
     def submit(self, sid, prompt, max_tokens=None, eos_id=None,
-               sampling=None):
+               sampling=None, trace=None):
         """Queue one session; admission happens at the next iteration.
         Rejections (prompt too long, duplicate sid) are emitted as
         session errors, not raised — submit is called from the replica's
-        message loop which must keep draining."""
+        message loop which must keep draining.  ``trace`` (a W3C
+        traceparent string) links replica-side admit/retire telemetry
+        into the originating request's trace."""
         cfg = self._spec.cfg
         prompt = [int(t) for t in prompt]
         if not prompt or len(prompt) > cfg.max_seq - 1:
@@ -322,7 +329,8 @@ class DecodeEngine:
                 "sid": sid, "prompt": prompt,
                 "max_tokens": int(max_tokens or self._spec.max_tokens),
                 "eos_id": self._spec.eos_id if eos_id is None else eos_id,
-                "sampling": sampling,
+                "sampling": sampling, "trace": trace,
+                "t_queued": time.perf_counter(),
             })
         self._wake.set()
 
@@ -560,8 +568,15 @@ class DecodeEngine:
             first = _sampling.sample_token(logits_row, req["sampling"], 0)
             mt = min(req["max_tokens"], cache.max_seq - plen)
             st = _Slot(req["sid"], plen, max(1, mt), req["eos_id"], first,
-                       req["sampling"])
+                       req["sampling"], trace=req.get("trace"))
             self._active[slot] = st
+            with telemetry.activate(st.trace):
+                telemetry.event(
+                    telemetry.DECODE_ADMIT, sid=st.sid, slot=slot,
+                    prompt_len=plen, prefix_hit_len=mlen,
+                    queue_ms=round((time.perf_counter()
+                                    - req.get("t_queued", time.perf_counter()))
+                                   * 1e3, 3))
             self._emit("token", st.sid, 0, first)
             if (st.eos_id is not None and first == st.eos_id) \
                     or st.max_tokens <= 1:
@@ -688,10 +703,16 @@ class DecodeEngine:
             self._sids.discard(st.sid)
         self.retired += 1
         metrics_registry.inc("tfos_decode_retired_total")
+        gen_ms = round((time.perf_counter() - st.t_admit) * 1e3, 3)
+        with telemetry.activate(st.trace):
+            telemetry.record_span(
+                telemetry.DECODE_RETIRE, gen_ms / 1e3, sid=st.sid,
+                tokens=len(st.generated), prompt_len=st.prompt_len,
+                replica=self._replica)
         self._emit("done", st.sid, list(st.generated), {
             "replica": self._replica,
             "prompt_len": st.prompt_len,
-            "gen_ms": round((time.perf_counter() - st.t_admit) * 1e3, 3),
+            "gen_ms": gen_ms,
         })
 
     def _fail_all(self, message):

@@ -333,7 +333,8 @@ def _make_replica_task(payload_blob, mgr_addr, mgr_authkey):
                         engine.submit(sid, req["prompt"],
                                       max_tokens=req.get("max_tokens"),
                                       eos_id=req.get("eos_id"),
-                                      sampling=req.get("sampling"))
+                                      sampling=req.get("sampling"),
+                                      trace=req.get("trace"))
                     except BaseException as e:  # noqa: BLE001 - one bad
                         # session must not take the replica down
                         outq.put(("gen_error", idx, sid, repr(e)))
@@ -504,6 +505,9 @@ class ReplicaPool:
             # the resolved sampling dict (seed included) rides the blob,
             # so a failover re-dispatch replays the identical stream
             "sampling": getattr(session, "sampling", None),
+            # traceparent header: replica-side admit/retire telemetry
+            # joins the originating request's trace tree
+            "trace": getattr(session, "trace", None),
         })
         idx = self._table.add(("gen", session.id),
                               {"session": session, "blob": blob})
@@ -546,6 +550,7 @@ class ReplicaPool:
                     # worst be answered twice (Batch resolves once, the
                     # duplicate is dropped).  Re-dispatch everything the
                     # old incarnation owned.
+                    self._record_lost(idx, "respawned")
                     self._redispatch({idx})
             elif kind == "down":
                 self._table.down(msg[1])
@@ -606,10 +611,9 @@ class ReplicaPool:
                                  self._beat_age, tfmanager.stale_after())
             for idx, why in dead:
                 self._table.lost(idx)
-                telemetry.event("serve/replica_lost", replica=idx,
-                                reason=why)
                 logger.warning("replica %d lost (%s); re-dispatching its "
                                "in-flight batches", idx, why)
+                self._record_lost(idx, why)
             if dead:
                 self._redispatch({idx for idx, _ in dead})
             # request timeout: fail requests stuck past the deadline so
@@ -645,6 +649,38 @@ class ReplicaPool:
         if moved["batch"] or moved["gen"]:
             telemetry.event("serve/redispatch", batches=moved["batch"],
                             sessions=moved["gen"], to=self._table.live())
+
+    def _record_lost(self, idx, why):
+        """Record one replica death: the telemetry event plus a
+        black-box flight dump of the dispatch table (docs/telemetry.md
+        "Flight recorder").  Called from whichever supervision path
+        notices first — the monitor's death scan or the respawned
+        incarnation's registration."""
+        telemetry.event("serve/replica_lost", replica=idx, reason=why)
+        try:  # never let a flight dump block failover
+            from tensorflowonspark_tpu.obs import flight as _flight
+
+            _flight.snapshot("serve/replica_lost",
+                             node=f"replica-{idx}", reason=why,
+                             inflight=self._inflight_summary())
+        except Exception:  # noqa: BLE001
+            logger.debug("flight snapshot failed", exc_info=True)
+
+    def _inflight_summary(self, limit=32):
+        """Small-scalar view of the dispatch table for flight dumps —
+        ids, owners and trace headers only, never prompts or blobs
+        (redaction contract, docs/telemetry.md "Flight recorder")."""
+        out = []
+        for key in list(self._table.keys())[:limit]:
+            entry = self._table.get(key)
+            if entry is None:
+                continue
+            item = {"kind": key[0], "id": key[1]}
+            sess = entry.get("session") if isinstance(entry, dict) else None
+            if sess is not None and getattr(sess, "trace", None):
+                item["trace"] = sess.trace
+            out.append(item)
+        return out
 
     def _proc_alive(self, idx):
         procs = getattr(self._engine, "_procs", None)

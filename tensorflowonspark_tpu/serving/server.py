@@ -258,25 +258,38 @@ class Server:
         return False
 
     # -- request path -------------------------------------------------------
-    def predict(self, example, timeout=None):
+    def predict(self, example, timeout=None, trace=None):
         """Serve one example ({tensor_name: array-like}, no batch axis);
         returns the outputs row.  Raises Overloaded on load shed,
-        TimeoutError past ``timeout`` (default TFOS_SERVE_TIMEOUT)."""
-        req = self.batcher.submit(example)
-        try:
-            return req.result(timeout or self.request_timeout)
-        except Overloaded:
-            raise
-        except Exception:
-            self.stats.observe_error()
-            metrics_registry.inc("tfos_serve_requests_total", status="error")
-            raise
+        TimeoutError past ``timeout`` (default TFOS_SERVE_TIMEOUT).
+
+        ``trace`` is an optional W3C-traceparent string (or
+        :class:`~..utils.telemetry.TraceContext`) linking this request
+        into a caller's trace; without one a fresh root is minted
+        (docs/telemetry.md "Causal tracing")."""
+        with telemetry.trace_span(telemetry.SERVE_PREDICT, header=trace):
+            req = self.batcher.submit(example)
+            try:
+                return req.result(timeout or self.request_timeout)
+            except Overloaded:
+                raise
+            except Exception:
+                self.stats.observe_error()
+                metrics_registry.inc("tfos_serve_requests_total",
+                                     status="error")
+                raise
 
     def generate(self, prompt, max_tokens=None, eos_id=None, timeout=None,
-                 temperature=None, top_k=None, top_p=None, seed=None):
+                 temperature=None, top_k=None, top_p=None, seed=None,
+                 trace=None):
         """One autoregressive decode session: ``prompt`` is a list of
         int token ids; returns ``{"tokens": [...], "ttft_ms", "token_ms"
         (per-token gaps), "total_ms", ...engine meta}``.
+
+        ``trace`` optionally links the session into a caller's trace
+        (W3C-traceparent string or TraceContext); the context is
+        carried inside the dispatch blob so replica-side decode spans
+        join the same tree (docs/telemetry.md "Causal tracing").
 
         Sampling: ``temperature > 0`` switches the session from greedy
         argmax to seeded sampling (``top_k``/``top_p`` optional).  The
@@ -307,6 +320,13 @@ class Server:
             seed = random.getrandbits(31)
         sampling = _sampling.make(temperature=temperature, top_k=top_k,
                                   top_p=top_p, seed=seed)
+        with telemetry.trace_span(telemetry.SERVE_GENERATE, header=trace,
+                                  prompt_len=len(prompt)):
+            return self._generate_traced(prompt, max_tokens, eos_id,
+                                         timeout, sampling)
+
+    def _generate_traced(self, prompt, max_tokens, eos_id, timeout,
+                         sampling):
         depth = self.pool.outstanding_sessions()
         if depth >= self.decode_queue_max:
             self.decode_stats.observe_shed()
@@ -314,13 +334,15 @@ class Server:
             telemetry.event(telemetry.DECODE_SHED, depth=depth,
                             limit=self.decode_queue_max)
             raise Overloaded(depth, self.decode_queue_max)
+        ctx = telemetry.current()
         session = _decode.PendingSession(
             next(self._session_ids), prompt,
             max_tokens or (self.spec.decode.max_tokens
                            if self.spec.decode else None)
             or _decode.max_tokens_default(),
             self.spec.decode.eos_id if eos_id is None else eos_id,
-            sampling=sampling)
+            sampling=sampling,
+            trace=ctx.to_header() if ctx is not None else None)
         self.pool.dispatch_session(session)
         try:
             out = session.result(timeout or self.request_timeout)
@@ -368,15 +390,16 @@ class Client:
     def __init__(self, server):
         self._server = server
 
-    def predict(self, example, timeout=None):
-        return self._server.predict(example, timeout=timeout)
+    def predict(self, example, timeout=None, trace=None):
+        return self._server.predict(example, timeout=timeout, trace=trace)
 
     def generate(self, prompt, max_tokens=None, eos_id=None, timeout=None,
-                 temperature=None, top_k=None, top_p=None, seed=None):
+                 temperature=None, top_k=None, top_p=None, seed=None,
+                 trace=None):
         return self._server.generate(prompt, max_tokens=max_tokens,
                                      eos_id=eos_id, timeout=timeout,
                                      temperature=temperature, top_k=top_k,
-                                     top_p=top_p, seed=seed)
+                                     top_p=top_p, seed=seed, trace=trace)
 
 
 # ---------------------------------------------------------------------------
@@ -430,7 +453,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(400, {"error": str(e)})
             return
         try:
-            row = srv.predict(example)
+            row = srv.predict(example,
+                              trace=self.headers.get("traceparent"))
         except Overloaded as e:
             # explicit load shed: 503 + retry-after (docs/serving.md)
             self._reply(503, {"error": "overloaded",
@@ -471,7 +495,8 @@ class _Handler(BaseHTTPRequestHandler):
                                temperature=payload.get("temperature"),
                                top_k=payload.get("top_k"),
                                top_p=payload.get("top_p"),
-                               seed=payload.get("seed"))
+                               seed=payload.get("seed"),
+                               trace=self.headers.get("traceparent"))
         except ValueError as e:
             # oversized/empty prompt, bad sampling range: client error
             self._reply(400, {"error": str(e)})

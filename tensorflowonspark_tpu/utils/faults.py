@@ -208,6 +208,17 @@ def check(site, **attrs):
                        f, hit, site, os.getpid())
         telemetry.event("fault/injected", site=site, kind=f.kind, hit=hit,
                         pid=os.getpid(), **attrs)
+        if f.kind in ("kill", "hang"):
+            # The victim's own black box: freeze the span ring NOW —
+            # after the SIGKILL nothing of this process survives but
+            # what is already on disk (obs/flight.py).
+            try:
+                from tensorflowonspark_tpu.obs import flight as _flight
+
+                _flight.snapshot("fault/injected", node=None,
+                                 reason=f"{f.kind}@{site} hit {hit}")
+            except Exception:  # noqa: BLE001 - injection must still fire
+                logger.debug("flight snapshot failed", exc_info=True)
         # a kill/hang never returns: the event must already be on disk
         telemetry.flush()
         if f.kind == "exc":

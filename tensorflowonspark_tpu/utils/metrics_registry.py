@@ -177,6 +177,17 @@ CATALOG = {
         "counter", "Eval-sidecar evaluations completed."),
     "tfos_eval_last_step": (
         "gauge", "Checkpoint step of the last completed evaluation."),
+    # SLO engine (obs/slo.py — driver process)
+    "tfos_slo_burn_rate": (
+        "gauge", "Error-budget burn rate per objective (1.0 spends the "
+                 "budget exactly; >1 is a breach in progress)."),
+    "tfos_slo_current": (
+        "gauge", "Current tracked value per objective (latency: the "
+                 "target-quantile milliseconds; availability: the good "
+                 "fraction)."),
+    "tfos_slo_breaches_total": (
+        "counter", "Objective transitions into breach (burn crossing "
+                   "above 1), by objective."),
 }
 
 

@@ -43,6 +43,11 @@ Env vars:
                             inherited by forked/spawned children.
   ``TFOS_TELEMETRY_BUFFER`` ring capacity (default 4096 records).
   ``TFOS_TELEMETRY_FLUSH``  flush threshold (default 128 records).
+  ``TFOS_TRACE_PARENT``     W3C-traceparent-shaped causal parent, the
+                            env channel by which spawned/forked children
+                            join the minting process's request trace.
+  ``TFOS_FLIGHT_RING``      flight-recorder ring capacity (default 512
+                            records; see obs/flight.py).
 """
 
 from __future__ import annotations
@@ -52,6 +57,7 @@ import collections
 import json
 import logging
 import os
+import re
 import socket
 import threading
 import time
@@ -64,6 +70,8 @@ NODE_ENV = "TFOS_TELEMETRY_NODE"
 ROLE_ENV = "TFOS_TELEMETRY_ROLE"
 BUFFER_ENV = "TFOS_TELEMETRY_BUFFER"
 FLUSH_ENV = "TFOS_TELEMETRY_FLUSH"
+TRACE_ENV = "TFOS_TRACE_PARENT"
+RING_ENV = "TFOS_FLIGHT_RING"
 
 SCHEMA_KEYS = ("ts", "node_id", "role", "kind", "name", "dur_ms", "attrs")
 
@@ -79,6 +87,130 @@ DECODE_SESSION = "decode/session"     # one autoregressive decode session
 DECODE_SHED = "decode/shed"           # decode admission-control rejection
 ACTOR_MESSAGE = "actor/message"       # one actor envelope handled
 EVAL_RUN = "eval/run"                 # one eval-sidecar evaluation
+SERVE_GENERATE = "serve/generate"     # request-root span, /v1/generate
+SERVE_PREDICT = "serve/predict"       # request-root span, /v1/predict
+DECODE_ADMIT = "decode/admit"         # replica-side session admission
+DECODE_RETIRE = "decode/retire"       # replica-side session retirement
+BENCH_REQUEST = "bench/request"       # loadgen per-request root span
+CLUSTER_RUN = "cluster/run"           # cluster root-trace anchor
+DATA_UNIT = "data/unit"               # one exactly-once data unit served
+
+
+# -- causal trace context (W3C-traceparent-shaped) -------------------------
+# A TraceContext links spans ACROSS processes: the string form
+# ``00-<32 hex trace_id>-<16 hex span_id>-01`` rides HTTP headers,
+# dispatch blobs, actor envelopes and the TFOS_TRACE_PARENT env var;
+# span records under an active context carry ``trace_id`` / ``span_id``
+# / ``parent_id`` inside ``attrs`` (the 7-key record schema above never
+# changes).  With no active context, attrs are left untouched.
+_TRACEPARENT_RE = re.compile(
+    r"^00-([0-9a-f]{32})-([0-9a-f]{16})-[0-9a-f]{2}$")
+
+
+class TraceContext:
+    """One node of a causal request tree.
+
+    ``span_id`` names the span that new child records parent to;
+    ``parent_id`` is where THIS context's own span (if any) links
+    upward (None at the root).  Wire form is ``to_header()``; a parsed
+    header yields a context whose ``span_id`` is the remote sender's
+    span, so children recorded under it link across the process
+    boundary."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id")
+
+    def __init__(self, trace_id=None, span_id=None, parent_id=None):
+        self.trace_id = trace_id or os.urandom(16).hex()
+        self.span_id = span_id or os.urandom(8).hex()
+        self.parent_id = parent_id
+
+    def child(self):
+        """A fresh context one level down (new span_id, parented here)."""
+        return TraceContext(self.trace_id, None, self.span_id)
+
+    def to_header(self):
+        """W3C-traceparent-shaped string form for wires and env vars."""
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    @classmethod
+    def from_header(cls, header):
+        """Parse a traceparent string; None on anything malformed."""
+        if isinstance(header, TraceContext):
+            return header
+        m = _TRACEPARENT_RE.match(str(header or "").strip())
+        if not m:
+            return None
+        return cls(m.group(1), m.group(2))
+
+    def __repr__(self):
+        return (f"TraceContext({self.trace_id[:8]}…, span={self.span_id}, "
+                f"parent={self.parent_id})")
+
+
+_TRACE_TLS = threading.local()
+# env-channel parse cache: (raw header string, parsed ctx)
+_ENV_PARENT = {"raw": None, "ctx": None}
+
+
+def current():
+    """The active TraceContext of this thread: the innermost activated
+    /traced span, else the ``TFOS_TRACE_PARENT`` env channel (how
+    spawned children inherit their parent), else None."""
+    stack = getattr(_TRACE_TLS, "stack", None)
+    if stack:
+        return stack[-1]
+    raw = os.environ.get(TRACE_ENV)
+    if not raw:
+        return None
+    if _ENV_PARENT["raw"] != raw:
+        _ENV_PARENT["ctx"] = TraceContext.from_header(raw)
+        _ENV_PARENT["raw"] = raw
+    return _ENV_PARENT["ctx"]
+
+
+def _push(ctx):
+    stack = getattr(_TRACE_TLS, "stack", None)
+    if stack is None:
+        stack = _TRACE_TLS.stack = []
+    stack.append(ctx)
+
+
+def _pop(ctx):
+    stack = getattr(_TRACE_TLS, "stack", None)
+    if stack and stack[-1] is ctx:
+        stack.pop()
+
+
+class _Activation:
+    """CM scoping an existing context onto this thread (wire receive)."""
+
+    __slots__ = ("_ctx",)
+
+    def __init__(self, ctx):
+        self._ctx = ctx
+
+    def __enter__(self):
+        if self._ctx is not None:
+            _push(self._ctx)
+        return self._ctx
+
+    def __exit__(self, *exc):
+        if self._ctx is not None:
+            _pop(self._ctx)
+        return False
+
+
+def activate(ctx):
+    """``with telemetry.activate(ctx_or_header):`` — make a context
+    received over a wire (dispatch blob, envelope, queue dict) the
+    active parent for spans/events in the body.  Accepts a
+    TraceContext, a traceparent string, or None (no-op); also a no-op
+    when telemetry is disabled."""
+    if ctx is None or _get() is None:
+        return _Activation(None)
+    if not isinstance(ctx, TraceContext):
+        ctx = TraceContext.from_header(ctx)
+    return _Activation(ctx)
 
 
 class Recorder:
@@ -95,6 +227,10 @@ class Recorder:
         cap = int(os.environ.get(BUFFER_ENV, "4096"))
         self._flush_every = int(os.environ.get(FLUSH_ENV, "128"))
         self._buf = collections.deque(maxlen=max(cap, 1))
+        # flight ring: the last N records, NOT drained by flush — the
+        # black-box window obs/flight.py snapshots on supervision events
+        self.ring = collections.deque(
+            maxlen=max(int(os.environ.get(RING_ENV, "512")), 1))
         self._lock = threading.Lock()
         self._last_flush = time.monotonic()
         self._sink_warned = False
@@ -126,6 +262,7 @@ class Recorder:
             if len(self._buf) == self._buf.maxlen:
                 self.dropped += 1
             self._buf.append(rec)
+            self.ring.append(rec)
             need = (len(self._buf) >= self._flush_every
                     or time.monotonic() - self._last_flush > 1.0)
         if need:
@@ -236,26 +373,50 @@ _NULL = _NullSpan()
 
 
 class Span:
-    """Context manager measuring one span on the monotonic clock."""
+    """Context manager measuring one span on the monotonic clock.
 
-    __slots__ = ("_rec", "name", "attrs", "_ts", "_t0")
+    Under an active :class:`TraceContext` the span joins the causal
+    tree: it derives (or is handed) a child context, becomes the active
+    parent for its body, and stamps ``trace_id``/``span_id``/
+    ``parent_id`` into its attrs on exit.  With no active context the
+    record is byte-identical to the pre-trace schema (attrs
+    untouched)."""
 
-    def __init__(self, rec, name, attrs):
+    __slots__ = ("_rec", "name", "attrs", "_ts", "_t0", "_ctx")
+
+    def __init__(self, rec, name, attrs, ctx=None):
         self._rec = rec
         self.name = name
         self.attrs = attrs
+        self._ctx = ctx
 
     def __enter__(self):
         self._ts = time.time()
         self._t0 = time.perf_counter()
+        if self._ctx is None:
+            parent = current()
+            if parent is not None:
+                self._ctx = parent.child()
+        if self._ctx is not None:
+            _push(self._ctx)
         return self
 
     def add(self, **attrs):
         self.attrs.update(attrs)
         return self
 
+    @property
+    def ctx(self):
+        """This span's TraceContext (None outside any trace)."""
+        return self._ctx
+
     def __exit__(self, exc_type, exc, tb):
         dur_ms = (time.perf_counter() - self._t0) * 1000.0
+        if self._ctx is not None:
+            _pop(self._ctx)
+            self.attrs.setdefault("trace_id", self._ctx.trace_id)
+            self.attrs.setdefault("span_id", self._ctx.span_id)
+            self.attrs.setdefault("parent_id", self._ctx.parent_id)
         if exc_type is not None:
             self.attrs.setdefault("error", repr(exc)[:200])
         self._rec.record("span", self.name, self._ts, dur_ms, self.attrs)
@@ -271,10 +432,29 @@ def span(name, **attrs):
     return Span(rec, name, attrs)
 
 
+def trace_span(name, header=None, **attrs):
+    """Entry-point span: like :func:`span` but ALWAYS traced — it
+    continues the trace in ``header`` (traceparent string or
+    TraceContext) when given, else the thread's active context, else
+    mints a fresh root.  Returns the no-op span when telemetry is
+    disabled (the overhead contract)."""
+    rec = _get()
+    if rec is None:
+        return _NULL
+    parent = TraceContext.from_header(header) if header else current()
+    ctx = parent.child() if parent is not None else TraceContext()
+    return Span(rec, name, attrs, ctx=ctx)
+
+
 def event(name, **attrs):
-    """Record an instant event (``dur_ms`` null)."""
+    """Record an instant event (``dur_ms`` null).  Under an active
+    trace the event is stamped as a leaf of the current span."""
     rec = _get()
     if rec is not None:
+        ctx = current()
+        if ctx is not None:
+            attrs.setdefault("trace_id", ctx.trace_id)
+            attrs.setdefault("parent_id", ctx.span_id)
         rec.record("event", name, time.time(), None, attrs)
 
 
@@ -285,8 +465,48 @@ def record_span(name, dur_s, **attrs):
     report the SAME number."""
     rec = _get()
     if rec is not None:
+        ctx = current()
+        if ctx is not None:
+            attrs.setdefault("trace_id", ctx.trace_id)
+            attrs.setdefault("span_id", os.urandom(8).hex())
+            attrs.setdefault("parent_id", ctx.span_id)
         rec.record("span", name, time.time() - dur_s, dur_s * 1000.0,
                    attrs)
+
+
+def trace_root(name, export=True, **attrs):
+    """Mint a root TraceContext for a long-lived scope (``cluster.run``)
+    and record an instant anchor span for it so every later child's
+    ``parent_id`` resolves.  ``export=True`` additionally publishes the
+    context on ``TFOS_TRACE_PARENT`` so this process's later spans AND
+    spawned children inherit it.  Returns the context (None when
+    telemetry is disabled)."""
+    rec = _get()
+    if rec is None:
+        return None
+    ctx = TraceContext()
+    attrs.setdefault("trace_id", ctx.trace_id)
+    attrs.setdefault("span_id", ctx.span_id)
+    attrs.setdefault("parent_id", None)
+    rec.record("span", name, time.time(), 0.0, attrs)
+    if export:
+        os.environ[TRACE_ENV] = ctx.to_header()
+    return ctx
+
+
+def recent(window_s=None):
+    """The flight ring: this process's last recorded spans/events (most
+    recent last), optionally clipped to the trailing ``window_s``
+    seconds.  Empty when telemetry is disabled."""
+    rec = _get()
+    if rec is None:
+        return []
+    with rec._lock:
+        records = list(rec.ring)
+    if window_s is not None:
+        cutoff = time.time() - float(window_s)
+        records = [r for r in records if r.get("ts", 0) >= cutoff]
+    return records
 
 
 def flush():

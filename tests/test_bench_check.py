@@ -9,6 +9,7 @@ codes the session scripts' ``host_run`` wiring reports.
 import importlib.util
 import json
 import os
+import re
 import subprocess
 import sys
 
@@ -120,6 +121,33 @@ def test_disjoint_lanes_is_skip(tmp_path):
     _write(tmp_path, "BENCH_r02.json", _line(tok_s=70e3, value=None))
     rc, out = _run(tmp_path)
     assert rc == 0 and "SKIP (no lane present in both" in out
+
+
+def test_run_stamp_keys_are_ignored_by_lanes(tmp_path, monkeypatch):
+    """bench.py stamps ``run_id``/``telemetry_dir`` into its line so a
+    BENCH file can be joined to its trace directory; bench_check must
+    treat those as non-lane metadata (ISSUE 12 satellite)."""
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    monkeypatch.setenv("TFOS_TELEMETRY_DIR", str(tmp_path / "tel"))
+    stamp = bench.run_stamp()
+    assert re.fullmatch(r"\d{8}T\d{6}-[0-9a-f]{6}", stamp["run_id"])
+    assert stamp["telemetry_dir"] == str(tmp_path / "tel")
+    assert bench.run_stamp()["run_id"] == stamp["run_id"]  # stable per run
+
+    bc = _load()
+    plain = _line(img_s=2500, p99=20)
+    stamped = dict(_line(img_s=2500, p99=20), **stamp)
+    assert bc.lanes_of(stamped) == bc.lanes_of(plain)
+
+    _write(tmp_path, "old.json", plain)
+    _write(tmp_path, "new.json", stamped)
+    rc, out = _run(tmp_path, "--baseline", str(tmp_path / "old.json"),
+                   "--latest", str(tmp_path / "new.json"))
+    assert rc == 0, out
 
 
 def test_real_repo_bench_files_are_comparable():

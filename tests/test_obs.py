@@ -27,6 +27,7 @@ from tensorflowonspark_tpu.cluster import InputMode
 from tensorflowonspark_tpu.engine import LocalEngine
 from tensorflowonspark_tpu.obs import http as obs_http
 from tensorflowonspark_tpu.obs import publish as obs_publish
+from tensorflowonspark_tpu.obs import slo as obs_slo
 from tensorflowonspark_tpu.obs import top as obs_top
 from tensorflowonspark_tpu.utils import metrics_registry as reg
 
@@ -35,7 +36,8 @@ pytestmark = pytest.mark.obs
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG = os.path.join(REPO, "tensorflowonspark_tpu")
 
-_ENV_KEYS = (reg.PORT_ENV, reg.INTERVAL_ENV, obs_http.HOST_ENV)
+_ENV_KEYS = (reg.PORT_ENV, reg.INTERVAL_ENV, obs_http.HOST_ENV,
+             obs_slo.SPEC_ENV)
 
 
 @pytest.fixture(autouse=True)
@@ -360,6 +362,20 @@ _CANNED = {
         "worker-1": {"role": "worker", "alive": False,
                      "heartbeat_age_s": 99.0, "summary": {}},
     },
+    # an obs/slo.py report, rendered only under --slo
+    "slo": [
+        {"name": "decode_ttft", "kind": "latency",
+         "metric": "tfos_decode_ttft_ms", "target_pct": 99.0,
+         "threshold_ms": 500.0, "current": 128.5, "burn": 0.4,
+         "breaching": False, "samples": 900},
+        {"name": "serve_availability", "kind": "availability",
+         "metric": "tfos_serve_requests_total", "target_pct": 99.0,
+         "current": 0.985, "burn": 1.5, "breaching": True,
+         "samples": 4000},
+        {"name": "quiet", "kind": "latency", "metric": "m",
+         "target_pct": 99.0, "threshold_ms": 10.0, "current": None,
+         "burn": None, "breaching": False, "samples": 0},
+    ],
 }
 
 
@@ -402,6 +418,126 @@ def test_tfos_top_errors_without_target():
     # unreachable target with --once: exit 2, not a hang
     assert obs_top.main(["--url", "http://127.0.0.1:1", "--once"],
                         out=io.StringIO()) == 2
+
+
+def test_tfos_top_slo_pane():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _StatuszStub)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        out = io.StringIO()
+        assert obs_top.main(["--url", url, "--once", "--slo"],
+                            out=out) == 0
+        text = out.getvalue()
+        assert "slo burn (obs/slo.py):" in text
+        lines = text.splitlines()
+        (ttft,) = [ln for ln in lines if "decode_ttft" in ln]
+        assert "<500ms" in ttft and "128.5ms" in ttft and "ok" in ttft
+        (avail,) = [ln for ln in lines if "serve_availability" in ln]
+        assert "BREACH" in avail and "98.5" in avail and "1.5" in avail
+        (quiet,) = [ln for ln in lines if ln.startswith("quiet")]
+        assert "no-data" in quiet
+        # without --slo the pane stays hidden
+        out2 = io.StringIO()
+        assert obs_top.main(["--url", url, "--once"], out=out2) == 0
+        assert "slo burn" not in out2.getvalue()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    assert "(no objectives reported)" in obs_top.render_slo({})
+
+
+# --- slo engine (obs/slo.py) -------------------------------------------------
+
+def test_slo_spec_parse_errors_disable_engine():
+    bad = (
+        "nope",                      # no fields at all
+        "n:weird:m@9",               # unknown kind
+        "n:latency:m@9",             # latency without threshold
+        "n:latency:m<x@9",           # non-numeric threshold
+        "n:availability:m@0",        # target must be in (0, 100)
+        "n:availability:m@100",
+        "n:availability:m",          # missing @good_pct
+        ":latency:m<1@9",            # empty name
+    )
+    for spec in bad:
+        with pytest.raises(ValueError):
+            obs_slo.parse_spec(spec)
+    assert obs_slo.parse_spec("") == []
+    # an invalid env/ctor spec disables the engine instead of raising
+    assert obs_slo.Engine("garbage").objectives == []
+    # the default spec parses and round-trips through repr
+    objs = obs_slo.parse_spec(obs_slo.DEFAULT_SPEC)
+    assert [o.name for o in objs] == ["decode_ttft", "serve_availability"]
+    again = obs_slo.parse_spec(";".join(repr(o) for o in objs))
+    assert [repr(o) for o in again] == [repr(o) for o in objs]
+
+
+def test_slo_engine_burn_math_and_edge_trigger():
+    _enable()
+    eng = obs_slo.Engine(
+        "av:availability:tfos_serve_requests_total@99;"
+        "lat:latency:tfos_decode_ttft_ms<500@99")
+    rep = eng.step([])
+    assert [r["burn"] for r in rep["objectives"]] == [None, None]
+    assert not any(r["breaching"] for r in rep["objectives"])
+
+    snap = {
+        "tfos_serve_requests_total": {"series": [
+            {"labels": {"status": "ok"}, "value": 90.0},
+            {"labels": {"status": "error"}, "value": 10.0},
+        ]},
+        "tfos_decode_ttft_ms": {"series": [
+            {"labels": {}, "bounds": [100.0, 500.0],
+             "counts": [8.0, 1.0, 1.0], "sum": 1000.0, "count": 10},
+        ]},
+    }
+    rep = eng.step([snap, snap])
+    by = {r["name"]: r for r in rep["objectives"]}
+    av, lat = by["av"], by["lat"]
+    # availability: 10% bad against a 1% error budget -> burn 10x
+    assert av["samples"] == 200 and av["current"] == pytest.approx(0.9)
+    assert av["burn"] == pytest.approx(10.0) and av["breaching"]
+    # latency: 10% of samples in the +Inf bucket (> 500ms) @ p99 target
+    assert lat["samples"] == 20
+    assert lat["burn"] == pytest.approx(10.0) and lat["breaching"]
+    assert lat["current"] == pytest.approx(500.0)  # clamps to last bound
+    # breach counter is edge-triggered: a second breaching step no-ops
+    eng.step([snap, snap])
+    series = reg.snapshot()["tfos_slo_breaches_total"]["series"]
+    counts = {s["labels"]["objective"]: s["value"] for s in series}
+    assert counts == {"av": 1.0, "lat": 1.0}
+
+
+def test_slo_endpoint_and_statusz_section():
+    _enable()
+    reg.inc("tfos_serve_requests_total", 99, status="ok")
+    reg.inc("tfos_serve_requests_total", 1, status="shed")
+    for _ in range(10):
+        reg.observe("tfos_decode_ttft_ms", 5.0)
+    srv = obs_http.ObsServer(cluster=None, port=0, interval=999).start()
+    try:
+        status, text = _get(srv.url + "/slo")
+        assert status == 200
+        doc = json.loads(text)
+        assert set(doc) == {"ts", "objectives"}
+        by = {r["name"]: r for r in doc["objectives"]}
+        av = by["serve_availability"]
+        assert av["burn"] == pytest.approx(1.0) and not av["breaching"]
+        ttft = by["decode_ttft"]
+        assert ttft["burn"] == 0.0 and ttft["samples"] == 10
+        # statusz grows an slo section once the poller has stepped
+        srv.poll_once()
+        status, text = _get(srv.url + "/statusz")
+        assert status == 200
+        names = {r["name"] for r in json.loads(text)["slo"]}
+        assert names == {"decode_ttft", "serve_availability"}
+        status, text = _get(srv.url + "/metrics")
+        assert "tfos_slo_burn_rate" in text
+        assert 'objective="serve_availability"' in text
+    finally:
+        srv.stop()
 
 
 # --- catalog / docs lint ----------------------------------------------------

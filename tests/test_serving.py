@@ -398,7 +398,7 @@ class _StubPool:
 class _ShedStub:
     pool = _StubPool()
 
-    def predict(self, example, timeout=None):
+    def predict(self, example, timeout=None, trace=None):
         raise B.Overloaded(5, 4, retry_after=0.25)
 
     def summary(self, include_replicas=False):

@@ -26,7 +26,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TRACE_MERGE = os.path.join(REPO, "scripts", "trace_merge.py")
 
 _ENV_KEYS = (telemetry.DIR_ENV, telemetry.SPOOL_ENV, telemetry.NODE_ENV,
-             telemetry.ROLE_ENV, telemetry.BUFFER_ENV, telemetry.FLUSH_ENV)
+             telemetry.ROLE_ENV, telemetry.BUFFER_ENV, telemetry.FLUSH_ENV,
+             telemetry.TRACE_ENV, telemetry.RING_ENV)
 
 
 def _load_trace_merge():
@@ -171,6 +172,98 @@ def test_spawn_child_roundtrip(tmp_path):
     assert child[0]["node_id"] == "parent"  # identity inherited via env
     files = sorted(f.name for f in tmp_path.iterdir())
     assert f"parent-{p.pid}.jsonl" in files
+
+
+# --- causal tracing ---------------------------------------------------------
+
+def test_trace_context_mint_child_header_roundtrip():
+    ctx = telemetry.TraceContext()
+    assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+    assert ctx.parent_id is None
+    kid = ctx.child()
+    assert kid.trace_id == ctx.trace_id
+    assert kid.parent_id == ctx.span_id and kid.span_id != ctx.span_id
+    hdr = kid.to_header()
+    assert hdr == f"00-{kid.trace_id}-{kid.span_id}-01"
+    back = telemetry.TraceContext.from_header(hdr)
+    assert back.trace_id == kid.trace_id and back.span_id == kid.span_id
+    # malformed headers parse to None, never raise
+    for bad in ("", "garbage", "00-zz-xx-01", None, "00-abc-def-01"):
+        assert telemetry.TraceContext.from_header(bad) is None
+
+
+def test_trace_span_links_parents_and_rides_attrs(tmp_path):
+    os.environ[telemetry.DIR_ENV] = str(tmp_path)
+    telemetry.configure(node_id="t-0", role="test")
+    with telemetry.trace_span("serve/request") as root:
+        rctx = root.ctx
+        with telemetry.span("engine/task"):
+            telemetry.event("tick")
+    telemetry.flush()
+    recs = {r["name"]: r for r in _records(telemetry.sink_path())}
+    outer, inner, tick = (recs["serve/request"], recs["engine/task"],
+                          recs["tick"])
+    assert outer["attrs"]["trace_id"] == rctx.trace_id
+    assert outer["attrs"]["parent_id"] is None
+    assert inner["attrs"]["trace_id"] == rctx.trace_id
+    assert inner["attrs"]["parent_id"] == outer["attrs"]["span_id"]
+    # events carry the enclosing span as parent
+    assert tick["attrs"]["parent_id"] == inner["attrs"]["span_id"]
+    # outside any trace, current() is empty again
+    assert telemetry.current() is None
+
+
+def test_trace_span_exception_path_pops_context(tmp_path):
+    os.environ[telemetry.DIR_ENV] = str(tmp_path)
+    telemetry.configure(node_id="t-0", role="test")
+    with pytest.raises(RuntimeError, match="kaboom"):
+        with telemetry.trace_span("serve/request"):
+            raise RuntimeError("kaboom")
+    # the thread-local stack MUST unwind on the error path, or every
+    # later span in this thread would silently join the failed trace
+    assert telemetry.current() is None
+    telemetry.flush()
+    (rec,) = _records(telemetry.sink_path())
+    assert "kaboom" in rec["attrs"]["error"]
+    assert rec["attrs"]["trace_id"]
+
+
+def _spawn_traced_child():
+    from tensorflowonspark_tpu.utils import telemetry as t
+
+    # the child sees the parent's exported context via TFOS_TRACE_PARENT
+    with t.span("spawn/traced_child"):
+        pass
+
+
+def test_trace_inherited_across_spawn(tmp_path):
+    """trace_root exports TFOS_TRACE_PARENT; a spawned child's spans
+    join the same trace with a valid parent link."""
+    os.environ[telemetry.DIR_ENV] = str(tmp_path)
+    telemetry.configure(node_id="parent", role="test")
+    ctx = telemetry.trace_root("cluster/run")
+    assert os.environ[telemetry.TRACE_ENV] == ctx.to_header()
+    p = mp.get_context("spawn").Process(target=_spawn_traced_child)
+    p.start()
+    p.join(60)
+    assert p.exitcode == 0
+    telemetry.flush()
+    recs = _all_records(tmp_path)
+    child = next(r for r in recs if r["name"] == "spawn/traced_child")
+    anchor = next(r for r in recs if r["name"] == "cluster/run")
+    assert child["attrs"]["trace_id"] == ctx.trace_id
+    assert child["attrs"]["parent_id"] == ctx.span_id
+    assert anchor["attrs"]["span_id"] == ctx.span_id
+
+
+def test_trace_disabled_is_noop(tmp_path):
+    assert not telemetry.enabled()
+    assert telemetry.trace_root("cluster/run") is None
+    assert telemetry.trace_span("serve/request") is telemetry._NULL
+    assert telemetry.current() is None
+    with telemetry.activate("00-" + "a" * 32 + "-" + "b" * 16 + "-01"):
+        assert telemetry.current() is None
+    assert list(tmp_path.iterdir()) == []
 
 
 # --- cluster drain ----------------------------------------------------------

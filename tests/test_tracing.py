@@ -1,0 +1,376 @@
+"""Causal request tracing, the span-catalog docs lint, the flight
+recorder, and ``tfos-postmortem``.
+
+Parity framing: the reference has neither request tracing nor a crash
+recorder — its failure story is free-text executor stdout
+(reference ``TFSparkNode.py:356``, SURVEY.md §5).  These tests pin the
+ISSUE 12 acceptance gates: one HTTP generate through a ReplicaPool
+yields ONE trace_id spanning at least two OS processes with every
+parent link resolving; ``trace_merge --trace`` renders that request's
+waterfall + critical path; flight dumps are bounded and
+redaction-safe; ``tfos-postmortem`` names the SIGKILLed node and the
+in-flight work at the moment of death (slow lane).
+"""
+
+import glob
+import importlib.util
+import io
+import json
+import os
+import re
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tensorflowonspark_tpu.obs import flight
+from tensorflowonspark_tpu.obs import postmortem
+from tensorflowonspark_tpu.utils import telemetry
+
+pytestmark = pytest.mark.tracing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "tensorflowonspark_tpu")
+TRACE_MERGE = os.path.join(REPO, "scripts", "trace_merge.py")
+
+_ENV_KEYS = (telemetry.DIR_ENV, telemetry.SPOOL_ENV, telemetry.NODE_ENV,
+             telemetry.ROLE_ENV, telemetry.TRACE_ENV, telemetry.RING_ENV,
+             flight.CAP_ENV, flight.WINDOW_ENV, flight.KEEP_ENV)
+
+
+@pytest.fixture(autouse=True)
+def _trace_env():
+    saved = {k: os.environ.get(k) for k in _ENV_KEYS}
+    for k in _ENV_KEYS:
+        os.environ.pop(k, None)
+    yield
+    telemetry.flush()
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def _load_trace_merge():
+    spec = importlib.util.spec_from_file_location("trace_merge", TRACE_MERGE)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _all_records(root):
+    out = []
+    for dirpath, _dirs, files in os.walk(str(root)):
+        for name in sorted(files):
+            if name.endswith(".jsonl"):
+                with open(os.path.join(dirpath, name),
+                          encoding="utf-8") as f:
+                    for ln in f:
+                        if ln.strip():
+                            out.append(json.loads(ln))
+    return out
+
+
+# --- span-catalog docs lint (satellite: docs lint) --------------------------
+
+# Literal first-arg span/event names at instrumentation call sites.
+# \s* spans continuation lines (cluster/start, node/boot, data/serve are
+# multi-line calls); f-strings never match (the quote isn't adjacent).
+_SPAN_CALL_RE = re.compile(
+    r'\.(?:span|event|record_span|trace_span|trace_root)\(\s*"([^"\n]+)"')
+# telemetry.py's ALL-CAPS name constants (the sites that emit through
+# them won't match the literal regex above)
+_CONST_RE = re.compile(r'^([A-Z][A-Z0-9_]*) = "([^"]*/[^"]*)"', re.M)
+
+
+def _code_span_names():
+    files = []
+    for dirpath, _dirs, names in os.walk(PKG):
+        for n in names:
+            # telemetry.py is excluded from the call-site scan (its
+            # docstrings show "phase/name" examples); its constants are
+            # folded in below instead
+            if n.endswith(".py") and not (
+                    dirpath.endswith("utils") and n == "telemetry.py"):
+                files.append(os.path.join(dirpath, n))
+    files.append(os.path.join(REPO, "bench.py"))
+    files.extend(glob.glob(os.path.join(REPO, "scripts", "*.py")))
+    names = set()
+    for path in files:
+        with open(path, encoding="utf-8") as f:
+            names.update(_SPAN_CALL_RE.findall(f.read()))
+    with open(os.path.join(PKG, "utils", "telemetry.py"),
+              encoding="utf-8") as f:
+        names.update(v for _k, v in _CONST_RE.findall(f.read()))
+    return names
+
+
+def _docs_span_names():
+    with open(os.path.join(REPO, "docs", "telemetry.md"),
+              encoding="utf-8") as f:
+        text = f.read()
+    section = text.split("## Span catalog", 1)[1].split("\n## ", 1)[0]
+    rows = re.findall(r"^\| `([^`]+)` \|", section, re.M)
+    assert rows, "docs/telemetry.md span-catalog table not found"
+    # rows containing < are f-string families, exempt from the
+    # code-side match by design (bench/<lane>, stress_fed/<mode>)
+    return {r for r in rows if "<" not in r}
+
+
+def test_span_catalog_matches_code_both_ways():
+    """Every literal span/event name the package, bench.py and scripts/
+    emit appears in docs/telemetry.md's span catalog, and every catalog
+    row is emitted somewhere (same discipline as the metric lint)."""
+    in_code = _code_span_names()
+    in_docs = _docs_span_names()
+    assert in_code <= in_docs, (
+        f"spans missing from docs/telemetry.md: {sorted(in_code - in_docs)}")
+    assert in_docs <= in_code, (
+        f"catalog rows never emitted: {sorted(in_docs - in_code)}")
+
+
+# --- CPU e2e gate: one request, one trace, >=2 processes --------------------
+
+def _decode_server(tmp_path):
+    import jax
+
+    from tensorflowonspark_tpu.models import transformer as T
+    from tensorflowonspark_tpu.serving import decode as D
+    from tensorflowonspark_tpu.serving import replicas as R
+    from tensorflowonspark_tpu.serving import server as S
+    from tensorflowonspark_tpu.utils import checkpoint as ckpt
+
+    cfg = T.Config(vocab_size=61, dim=32, n_layers=2, n_heads=2,
+                   max_seq=32, dtype="float32", attn_impl="reference")
+    params = T.init(jax.random.PRNGKey(0), cfg)
+    export = str(tmp_path / "export")
+    ckpt.export_model(export, params, metadata={})
+    spec = R.ModelSpec(export_dir=export,
+                       decode=D.DecodeSpec(cfg, slots=4, max_tokens=8))
+    return S, spec
+
+
+def test_http_generate_is_one_trace_across_processes(tmp_path, monkeypatch):
+    """THE tentpole gate: a single ``POST /v1/generate`` through a
+    1-replica pool produces one trace_id whose spans come from at least
+    two OS processes (driver + replica), every parent_id resolves
+    inside the trace, and a client traceparent header is continued, not
+    replaced.  Then ``trace_merge --trace`` renders the waterfall and
+    the queue/prefill/decode critical-path decomposition from the same
+    spools."""
+    tdir = tmp_path / "telemetry"
+    tdir.mkdir()
+    monkeypatch.setenv(telemetry.DIR_ENV, str(tdir))
+    telemetry.configure(node_id="driver", role="driver")
+    S, spec = _decode_server(tmp_path)
+    prompt = [2, 3, 5, 7]
+    client = telemetry.TraceContext()  # the "remote caller"'s context
+    with S.Server(spec, num_replicas=1, request_timeout=300) as srv:
+        httpd = S.serve_http(srv, port=0, block=False)
+        try:
+            host, port = httpd.server_address
+            for hdrs in ({}, {"traceparent": client.to_header()}):
+                req = urllib.request.Request(
+                    f"http://{host}:{port}/v1/generate",
+                    data=json.dumps({"prompt": prompt,
+                                     "max_tokens": 6}).encode(),
+                    headers={"Content-Type": "application/json", **hdrs})
+                with urllib.request.urlopen(req, timeout=300) as resp:
+                    assert resp.status == 200
+        finally:
+            httpd.shutdown()
+    telemetry.flush()
+
+    recs = _all_records(tdir)
+    gens = [r for r in recs if r["name"] == "serve/generate"]
+    assert len(gens) == 2
+    # (a) the header request CONTINUES the client's trace: same
+    # trace_id, parented at the client's span
+    cont = [r for r in gens
+            if r["attrs"]["trace_id"] == client.trace_id]
+    assert len(cont) == 1
+    assert cont[0]["attrs"]["parent_id"] == client.span_id
+    # (b) the headerless request minted its own root; use it for the
+    # structural no-orphan check (its whole tree lives in the spools)
+    (root,) = [r for r in gens if r is not cont[0]]
+    tid = root["attrs"]["trace_id"]
+    assert tid != client.trace_id and root["attrs"]["parent_id"] is None
+    trace = [r for r in recs
+             if (r.get("attrs") or {}).get("trace_id") == tid]
+    names = {r["name"] for r in trace}
+    assert {"serve/generate", "decode/session",
+            "decode/admit", "decode/retire"} <= names
+    # one request, >=2 OS processes on one causal tree
+    assert len({r["node_id"] for r in trace}) >= 2
+    span_ids = {r["attrs"]["span_id"] for r in trace
+                if r["kind"] == "span" and "span_id" in r["attrs"]}
+    for r in trace:
+        parent = r["attrs"].get("parent_id")
+        assert parent is None or parent in span_ids, (r["name"], parent)
+    # admission queue time rides the replica-side admit event
+    (admit,) = [r for r in trace if r["name"] == "decode/admit"]
+    assert admit["attrs"]["queue_ms"] >= 0
+
+    # (c) the merge tool renders the request end to end
+    tm = _load_trace_merge()
+    full, t_recs = tm.find_trace([(r, "x") for r in recs], tid[:16])
+    assert full == tid
+    text, stats = tm.render_waterfall(full, t_recs)
+    assert stats["orphans"] == 0 and len(stats["nodes"]) >= 2
+    assert stats["critical_path"][0] == "serve/generate"
+    assert stats["decomposition"]["total"] > 0
+    assert stats["decomposition"]["decode"] is not None
+    assert "-- critical path" in text and "decode/admit" in text
+    # the CLI entry: exit 0 and the waterfall on stdout
+    out = os.path.join(str(tmp_path), "trace_stats.json")
+    import contextlib
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = tm.main([str(tdir), "--trace", tid, "--summary-json", out])
+    assert rc == 0 and f"trace {tid}" in buf.getvalue()
+    with open(out, encoding="utf-8") as f:
+        assert json.load(f)["trace_id"] == tid
+    # an empty prefix matches both traces -> loud ambiguity, never a
+    # silently-merged waterfall
+    with pytest.raises(ValueError, match="ambiguous"):
+        tm.find_trace([(r, "x") for r in recs], "")
+
+
+# --- flight recorder (satellite: bounded + redaction-safe) ------------------
+
+def test_flight_snapshot_disabled_is_noop(tmp_path):
+    assert not telemetry.enabled()
+    assert flight.snapshot("test/trigger") is None
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_flight_dump_redacts_and_bounds(tmp_path, monkeypatch):
+    monkeypatch.setenv(telemetry.DIR_ENV, str(tmp_path))
+    monkeypatch.setenv(flight.CAP_ENV, "4096")
+    telemetry.configure(node_id="t-0", role="test")
+    for i in range(200):
+        telemetry.event("spin", i=i, note="n" * 120)
+    telemetry.event("secret", prompt="p" * 500, blob=[1, 2, 3],
+                    arr={"nested": 1})
+    path = flight.snapshot(
+        "serve/replica_lost", node="replica-1", reason="proc-exit",
+        inflight=[{"kind": "gen", "id": 7, "prompt": "q" * 500,
+                   "tensor": object()}])
+    assert path and os.path.exists(path)
+    # bounded: the dump obeys the byte cap by dropping oldest records,
+    # and says how many it dropped
+    assert os.path.getsize(path) <= 4096 + 16
+    with open(path, encoding="utf-8") as f:
+        dump = json.load(f)
+    assert dump["trigger"] == "serve/replica_lost"
+    assert dump["node"] == "replica-1"
+    assert dump["truncated"] > 0
+    # redaction: strings clipped at 200 chars, non-scalars typed out
+    (entry,) = dump["inflight"]
+    assert entry["kind"] == "gen" and entry["id"] == 7
+    assert len(entry["prompt"]) == 201 and entry["prompt"].endswith("…")
+    assert entry["tensor"] == "<redacted object>"
+    kept = {r["name"]: r for r in dump["records"]}
+    if "secret" in kept:  # newest records survive the cap
+        a = kept["secret"]["attrs"]
+        assert len(a["prompt"]) == 201
+        assert a["blob"] == "<redacted list>"
+        assert a["arr"] == "<redacted dict>"
+
+
+def test_flight_rotation_keeps_newest(tmp_path, monkeypatch):
+    monkeypatch.setenv(telemetry.DIR_ENV, str(tmp_path))
+    monkeypatch.setenv(flight.KEEP_ENV, "2")
+    telemetry.configure(node_id="t-0", role="test")
+    telemetry.event("tick")
+    paths = [flight.snapshot("test/trigger") for _ in range(4)]
+    assert all(paths)
+    left = sorted(glob.glob(str(tmp_path / "flight-*.json")))
+    assert left == sorted(paths[-2:])
+
+
+# --- tfos-postmortem --------------------------------------------------------
+
+def test_postmortem_skips_corrupt_and_reports_victim(tmp_path, monkeypatch):
+    buf = io.StringIO()
+    assert postmortem.main(["--dir", str(tmp_path)], out=buf) == 2
+    assert "no usable flight dumps" in buf.getvalue()
+
+    monkeypatch.setenv(telemetry.DIR_ENV, str(tmp_path))
+    telemetry.configure(node_id="driver", role="driver")
+    telemetry.event("serve/replica_lost", replica=1, reason="proc-exit")
+    telemetry.flush()
+    assert flight.snapshot("serve/replica_lost", node="replica-1",
+                           reason="proc-exit",
+                           inflight=[{"kind": "gen", "id": 3}])
+    # a SIGKILL can land mid-write: torn and wrong-shaped dumps are
+    # skipped WITH a count, never fatal
+    (tmp_path / "flight-torn-1-0001.json").write_text('{"trigger": "x"')
+    (tmp_path / "flight-shape-1-0001.json").write_text('{"nope": 1}')
+    dumps, corrupt = postmortem.load_dumps(str(tmp_path))
+    assert len(dumps) == 1 and corrupt == 2
+
+    buf = io.StringIO()
+    assert postmortem.main(["--dir", str(tmp_path)], out=buf) == 0
+    text = buf.getvalue()
+    assert "skipped 2 corrupt/truncated" in text
+    assert "victim=replica-1" in text and "reason=proc-exit" in text
+    assert "kind=gen id=3" in text
+    assert "serve/replica_lost" in text  # the spool window table
+
+
+# --- slow lane: the postmortem gate -----------------------------------------
+
+@pytest.mark.slow
+def test_postmortem_after_sigkill_mid_decode(tmp_path, monkeypatch):
+    """ISSUE 12 postmortem gate: SIGKILL a replica mid-decode, then
+    ``tfos-postmortem`` over the telemetry tree names the killed node
+    and shows the sessions that were in flight when it died."""
+    tdir = tmp_path / "telemetry"
+    tdir.mkdir()
+    monkeypatch.setenv(telemetry.DIR_ENV, str(tdir))
+    telemetry.configure(node_id="driver", role="driver")
+    S, spec = _decode_server(tmp_path)
+    with S.Server(spec, num_replicas=2, request_timeout=300) as srv:
+        srv.generate([1, 2, 3], max_tokens=2, timeout=300)  # warm compiles
+        errs = []
+
+        def one(i):
+            try:
+                srv.generate([2 + i, 3, 5], max_tokens=20, timeout=300)
+            except Exception as e:  # noqa: BLE001 - asserted below
+                errs.append(e)
+
+        ts = [threading.Thread(target=one, args=(i,)) for i in range(6)]
+        for t in ts:
+            t.start()
+        deadline = time.time() + 120
+        while srv.pool.outstanding_sessions() < 3 and \
+                time.time() < deadline:
+            time.sleep(0.01)
+        pids = srv.pool.replica_pids()
+        victim = sorted(pids)[0]
+        os.kill(pids[victim], 9)
+        for t in ts:
+            t.join()
+        assert not errs, errs
+        # the monitor snapshotted the flight ring when it noticed
+        deadline = time.time() + 30
+        while not glob.glob(str(tdir / "flight-*.json")) and \
+                time.time() < deadline:
+            time.sleep(0.05)
+    telemetry.flush()
+
+    dumps = glob.glob(str(tdir / "flight-*.json"))
+    assert dumps, "no flight dump written on replica loss"
+    buf = io.StringIO()
+    assert postmortem.main(["--dir", str(tdir), "--all"], out=buf) == 0
+    text = buf.getvalue()
+    assert "trigger=serve/replica_lost" in text
+    assert f"victim=replica-{victim}" in text
+    # the in-flight sessions at the moment of death are named
+    assert "kind=gen" in text
+    # and the spool window attributes activity to the nodes
+    assert "records   last:" in text
