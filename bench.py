@@ -846,6 +846,7 @@ def main():
                      ("segmentation", _segmentation_bench),
                      ("batch_inference", _inference_bench),
                      ("serve", _serve_bench),
+                     ("elastic_serve", _elastic_serve_bench),
                      ("decode", _decode_bench),
                      ("data", _data_bench),
                      ("elastic", _elastic_bench),
@@ -1244,6 +1245,114 @@ def _serve_bench(dev, on_tpu):
         if compiles:
             out["compiles"] = compiles
         return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _elastic_serve_bench(dev, on_tpu):
+    """Elastic-serving lane (TFOS_BENCH_ELASTIC_SERVE=0 to skip): the
+    serve lane's open-loop Poisson load against a 2-replica
+    degrade-by-resize pool, with one replica SIGKILLed a third of the
+    way through the arrival schedule (docs/serving.md "Degrade by
+    resize").  Reports the degraded-window p99, the pool resize time
+    and ``dropped`` — client-visible request errors, which the
+    zero-drop contract pins at 0 (sheds are counted separately; they
+    are explicit 503s, not drops).  Replicas are CPU-forced like the
+    serve lane: this measures failover choreography, not the chip.
+    """
+    import shutil
+    import signal
+    import tempfile
+    import threading
+
+    import jax
+
+    from tensorflowonspark_tpu import serving
+    from tensorflowonspark_tpu.models import mnist
+    from tensorflowonspark_tpu.serving.decode import run_open_loop
+    from tensorflowonspark_tpu.utils import checkpoint as ckpt
+
+    n_requests = int(os.environ.get("TFOS_BENCH_ELASTIC_SERVE_N", "240"))
+    rate_rps = float(os.environ.get("TFOS_BENCH_ELASTIC_SERVE_RPS", "80"))
+    tmp = tempfile.mkdtemp(prefix="tfos_bench_eserve_")
+    try:
+        params = mnist.init_params(jax.random.PRNGKey(0))
+        export = os.path.join(tmp, "export")
+        ckpt.export_model(export, params, metadata={
+            "predict": "tensorflowonspark_tpu.models.mnist:serve_predict",
+        })
+        spec = serving.ModelSpec(export_dir=export)
+        rng = np.random.default_rng(0)
+        images = rng.random((64, 28, 28, 1), np.float32)
+
+        with serving.Server(
+            spec, num_replicas=2, max_batch=32, max_delay_ms=5,
+            elastic=True,
+            env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""},
+        ) as srv:
+            client = srv.client()
+            for _ in range(2):
+                client.predict({"image": images[0]}, timeout=120)
+
+            kill_at = max(1, n_requests // 3)
+            killed = {"pid": None}
+            deg_lock = threading.Lock()
+            deg_ms = []
+
+            def request(i):
+                if i == kill_at and killed["pid"] is None:
+                    live = srv.pool.live_replicas()
+                    victim = srv.pool.replica_pids()[live[0]]
+                    killed["pid"] = victim
+                    os.kill(victim, signal.SIGKILL)
+                with telemetry.trace_span(telemetry.BENCH_REQUEST,
+                                          lane="elastic_serve", req=i):
+                    t0 = time.perf_counter()
+                    row = client.predict(
+                        {"image": images[i % len(images)]}, timeout=120)
+                    if srv.pool.degraded:
+                        with deg_lock:
+                            deg_ms.append((time.perf_counter() - t0) * 1e3)
+                    return row and None
+
+            stats = run_open_loop(
+                request,
+                rate_rps=rate_rps, n_requests=n_requests, seed=0,
+                shed_exc=serving.Overloaded)
+            # regrow: the engine respawn adopts live params, the pool
+            # reshards back to full capacity — wait for it so the lane
+            # reports the restored state, not a race
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if (len(srv.pool.live_replicas()) == 2
+                        and not srv.pool.degraded):
+                    break
+                time.sleep(0.2)
+            pool = srv.pool.describe()
+
+        deg_sorted = sorted(deg_ms)
+        deg_p99 = (deg_sorted[min(len(deg_sorted) - 1,
+                                  round(0.99 * (len(deg_sorted) - 1)))]
+                   if deg_sorted else None)
+        return {
+            "requests": stats["requests"],
+            "req_per_sec": stats["completed_rps"],
+            "offered_rps": stats["offered_rps"],
+            "p50_ms": stats["latency_p50_ms"],
+            "p99_ms": stats["latency_p99_ms"],
+            # degraded-window latency; falls back to overall p99 when
+            # the resize outran every in-window arrival (samples says so)
+            "degraded_p99_ms": (round(deg_p99, 3) if deg_p99 is not None
+                                else stats["latency_p99_ms"]),
+            "degraded_samples": len(deg_sorted),
+            "resize_ms": pool["last_resize_ms"],
+            "resizes": pool["resizes"],
+            "generation": pool["generation"],
+            "adoptions": pool["adoptions"],
+            "regrown": pool["live"],
+            "shed": stats["shed"],
+            "dropped": stats["errors"],
+        }
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 

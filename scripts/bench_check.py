@@ -73,6 +73,11 @@ LANES = (
      ("extra", "decode", "prefill_tokens_saved"), True),
     ("elastic.resize_ms", ("extra", "elastic", "resize_ms"), False),
     ("elastic.reshard_ms", ("extra", "elastic", "reshard_ms"), False),
+    ("elastic_serve.resize_ms",
+     ("extra", "elastic_serve", "resize_ms"), False),
+    ("elastic_serve.degraded_p99_ms",
+     ("extra", "elastic_serve", "degraded_p99_ms"), False),
+    ("elastic_serve.dropped", ("extra", "elastic_serve", "dropped"), False),
     ("actors.ask_p50_ms", ("extra", "actors", "ask_p50_ms"), False),
     ("actors.ask_p99_ms", ("extra", "actors", "ask_p99_ms"), False),
     ("actors.respawn_resume_ms",
@@ -159,6 +164,12 @@ def compare(old_lanes, new_lanes, tolerance):
             continue
         old, new = old_lanes[label], new_lanes[label]
         if old <= 0:
+            # zero is a meaningful floor for lower-is-better lanes
+            # (elastic_serve.dropped: the zero-drop contract) — any
+            # departure from it regresses; ratios are undefined, so
+            # report the absolute delta as the change
+            if old == 0 and not hib:
+                rows.append((label, old, new, new, new > tolerance))
             continue
         rel = (new - old) / old
         regressed = (rel < -tolerance) if hib else (rel > tolerance)

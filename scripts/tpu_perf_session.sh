@@ -70,6 +70,7 @@ else
   # second TPU claim); TFOS_BENCH_SERVE=0 / TFOS_BENCH_DECODE=0 skip
   # them if the host is too loaded for meaningful latency percentiles
   TFOS_BENCH_SERVE="${TFOS_BENCH_SERVE:-1}" \
+  TFOS_BENCH_ELASTIC_SERVE="${TFOS_BENCH_ELASTIC_SERVE:-1}" \
   TFOS_BENCH_DECODE="${TFOS_BENCH_DECODE:-1}" \
   TFOS_BENCH_DECODE_PREFIX="${TFOS_BENCH_DECODE_PREFIX:-0.6}" \
     session_run 7200 python bench.py

@@ -59,6 +59,7 @@ else
   # claim this step holds); TFOS_BENCH_SERVE=0 / TFOS_BENCH_DECODE=0
   # to skip
   TFOS_BENCH_SERVE="${TFOS_BENCH_SERVE:-1}" \
+  TFOS_BENCH_ELASTIC_SERVE="${TFOS_BENCH_ELASTIC_SERVE:-1}" \
   TFOS_BENCH_DECODE="${TFOS_BENCH_DECODE:-1}" \
   TFOS_BENCH_DECODE_PREFIX="${TFOS_BENCH_DECODE_PREFIX:-0.6}" \
     session_run 7200 bash -c 'python bench.py > BENCH_session_r5.json.tmp \
@@ -102,6 +103,7 @@ if [ "$smoke" = "1" ]; then
   echo "-- final bench.py skipped (smoke mode) --" | tee -a "$log"
 else
   TFOS_BENCH_SERVE="${TFOS_BENCH_SERVE:-1}" \
+  TFOS_BENCH_ELASTIC_SERVE="${TFOS_BENCH_ELASTIC_SERVE:-1}" \
   TFOS_BENCH_DECODE="${TFOS_BENCH_DECODE:-1}" \
   TFOS_BENCH_DECODE_PREFIX="${TFOS_BENCH_DECODE_PREFIX:-0.6}" \
     session_run 7200 bash -c 'python bench.py > BENCH_session_r5_final.json.tmp \

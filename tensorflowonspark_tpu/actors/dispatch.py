@@ -33,6 +33,7 @@ class InFlightTable:
         self._pids = {}          # idx -> os pid (latest incarnation)
         self._loads = {}         # idx -> in-flight count
         self._entries = {}       # key -> entry dict (+"owner"/"t")
+        self._quiesced = set()   # live but not accepting NEW work (drain)
 
     # -- membership -----------------------------------------------------------
     def up(self, idx, pid):
@@ -57,6 +58,17 @@ class InFlightTable:
         with self._lock:
             self._live.discard(idx)
             self._loads.pop(idx, None)
+            self._quiesced.discard(idx)
+
+    def quiesce(self, idx):
+        """Stop routing NEW work to a live member (graceful drain: it
+        keeps its in-flight entries and stays live until retired)."""
+        with self._lock:
+            self._quiesced.add(idx)
+
+    def unquiesce(self, idx):
+        with self._lock:
+            self._quiesced.discard(idx)
 
     def live(self):
         with self._lock:
@@ -72,7 +84,12 @@ class InFlightTable:
 
     # -- dispatch -------------------------------------------------------------
     def _pick_locked(self):
-        candidates = sorted(self._live) or list(range(self.pool_size))
+        # quiesced members are skipped while any other live member can
+        # take the work; when every live member is draining they are
+        # still preferred over a blind pool_size guess.
+        candidates = (sorted(self._live - self._quiesced)
+                      or sorted(self._live)
+                      or list(range(self.pool_size)))
         return min(candidates, key=lambda i: (self._loads.get(i, 0), i))
 
     def add(self, key, entry, owner=None):
@@ -131,6 +148,13 @@ class InFlightTable:
         with self._lock:
             return [k for k, e in self._entries.items()
                     if e["owner"] in idxs]
+
+    def owned_count(self, idx):
+        """In-flight entries currently assigned to ``idx`` (the drain
+        loop polls this down to zero before retiring a member)."""
+        with self._lock:
+            return sum(1 for e in self._entries.values()
+                       if e["owner"] == idx)
 
     def stale(self, timeout, now=None):
         """Pop and return [(key, entry)] older than ``timeout`` —

@@ -101,6 +101,15 @@ def node_summary(snap):
         sq = _metric_gauge(snap, "tfos_serve_queue_depth")
         if sq is not None:
             out["queue_depth"] = sq
+    gen = _metric_gauge(snap, "tfos_serve_pool_generation")
+    if gen is not None:
+        out["pool_generation"] = gen
+        out["pool_degraded"] = _metric_gauge(
+            snap, "tfos_serve_pool_degraded")
+        rh = _metric_hist(snap, "tfos_serve_resize_seconds")
+        if rh:
+            out["resize_p99_s"] = _round(
+                metrics_registry.quantile(rh, 0.99), 4)
     dh = _metric_hist(snap, "tfos_decode_ttft_ms")
     if dh:
         out["decode_ttft_p99_ms"] = _round(
@@ -359,6 +368,16 @@ class ObsServer:
             rows = []
         if rows:
             out["actors"] = rows
+        # Elastic serving pools: generation, capacity, assignments —
+        # the degrade-by-resize state (same lazy pattern as actors).
+        try:
+            from tensorflowonspark_tpu.serving.elastic import pool_table
+
+            pools = pool_table()
+        except Exception:  # noqa: BLE001 - pools tearing down
+            pools = []
+        if pools:
+            out["pools"] = pools
         return out
 
     def render_slo(self):

@@ -11,6 +11,10 @@ Pieces:
     micro-batching into padded power-of-two shape buckets;
   - :mod:`~tensorflowonspark_tpu.serving.replicas` — supervised model
     replicas with least-loaded dispatch and checkpoint hot-reload;
+  - :mod:`~tensorflowonspark_tpu.serving.elastic` — degrade-by-resize
+    replica pool: logical capacity, live param resharding on loss,
+    adopt-on-respawn, graceful drain (docs/serving.md "Degrade by
+    resize");
   - :mod:`~tensorflowonspark_tpu.serving.server` — in-process Client,
     stdlib HTTP endpoint, SLO stats, ``tfos-serve`` CLI;
   - :mod:`~tensorflowonspark_tpu.serving.decode` — continuous-batching
@@ -32,6 +36,9 @@ from tensorflowonspark_tpu.serving.decode import (  # noqa: F401
     DecodeSpec,
     PendingSession,
     run_open_loop,
+)
+from tensorflowonspark_tpu.serving.elastic import (  # noqa: F401
+    ElasticReplicaPool,
 )
 from tensorflowonspark_tpu.serving.replicas import (  # noqa: F401
     ModelSpec,

@@ -301,6 +301,7 @@ class MicroBatcher:
         self._observer = observer
         self._batch_observer = batch_observer
         self._on_shed = on_shed
+        self._capacity = 1.0
         self._q = _queue.Queue()
         self._ids = itertools.count(1)
         self._closed = False
@@ -313,6 +314,33 @@ class MicroBatcher:
             self._thread.start()
         return self
 
+    def set_capacity(self, frac):
+        """Declared degraded-mode admission (docs/serving.md "Degrade by
+        resize"): scale the effective queue bound by the pool's
+        live/logical capacity fraction, so load past a shrunk pool sheds
+        proportionally with Retry-After instead of queueing into
+        timeouts.  ``frac=0`` (no live replicas) sheds everything —
+        explicit 503s, never a silent stall."""
+        frac = max(0.0, min(1.0, float(frac)))
+        if frac != self._capacity:
+            logger.info("batcher capacity -> %.0f%% (queue bound %d -> %d)",
+                        frac * 100, self.effective_queue_max(),
+                        self._bound_for(frac))
+        self._capacity = frac
+
+    @property
+    def degraded(self):
+        return self._capacity < 1.0
+
+    def _bound_for(self, frac):
+        return int(round(self.queue_max * frac)) if frac > 0 else 0
+
+    def effective_queue_max(self):
+        """Admission bound at the current capacity fraction (>=1 while
+        any capacity remains — a degraded pool still serves)."""
+        return max(1, self._bound_for(self._capacity)) \
+            if self._capacity > 0 else 0
+
     def submit(self, example):
         """Queue one example ({tensor_name: array-like}, no batch axis);
         returns a :class:`PendingResult`.  Raises :class:`Overloaded`
@@ -324,16 +352,21 @@ class MicroBatcher:
                 "example must be a non-empty {tensor_name: array} dict")
         depth = self._q.qsize()
         metrics_registry.set_gauge("tfos_serve_queue_depth", depth)
-        if depth >= self.queue_max:
+        limit = self.effective_queue_max()
+        if depth >= limit:
             # shed BEFORE enqueueing: bounded queue depth is the whole
             # point — admitting then failing would still grow memory
             if self._on_shed is not None:
                 try:
-                    self._on_shed(depth, self.queue_max)
+                    self._on_shed(depth, limit)
                 except Exception:  # noqa: BLE001
                     logger.exception("serving shed observer failed")
-            raise Overloaded(depth, self.queue_max,
-                             retry_after=max(self.max_delay_s, 0.05))
+            # degraded sheds hint a longer backoff: capacity returns on
+            # pool-regrow timescales, not batch-flush timescales
+            retry = max(self.max_delay_s, 0.05)
+            if self.degraded:
+                retry = max(retry, 0.25)
+            raise Overloaded(depth, limit, retry_after=retry)
         req = PendingResult(
             {k: np.asarray(v) for k, v in example.items()})
         self._q.put(req)

@@ -60,7 +60,7 @@ import numpy as np
 from tensorflowonspark_tpu.actors.ledger import IndexLedger, ResolveOnce
 from tensorflowonspark_tpu.serving import batcher as _batcher
 from tensorflowonspark_tpu.serving.decode import sampling as _sampling
-from tensorflowonspark_tpu.utils import metrics_registry, telemetry
+from tensorflowonspark_tpu.utils import faults, metrics_registry, telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -429,6 +429,7 @@ class DecodeEngine:
         self._started.set()
         while not self._stop.is_set():
             try:
+                faults.check("decode.step", replica=self._replica)
                 self._admit(cache, dcache)
                 if not self._active:
                     self._wake.wait(0.02)

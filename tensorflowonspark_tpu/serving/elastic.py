@@ -1,0 +1,480 @@
+"""Elastic serving tier: degrade-by-resize replica pool (docs/serving.md
+"Degrade by resize").
+
+No reference equivalent — the reference's only answer to a lost serving
+node is cold respawn (Inference.scala:27-79 runs a fixed mapPartitions
+job; TFSparkNode.py:480-482 has no serving tier at all).  The base
+:class:`~tensorflowonspark_tpu.serving.replicas.ReplicaPool` inherits
+that shape: SIGKILL -> engine respawn -> checkpoint reload.  This
+subclass carries the training side's elastic contract
+(``elastic/virtual.py`` + ``elastic/reshard.py``, VirtualFlow arXiv
+2009.09523; the stable-replica-abstraction framing is TF-Replicator,
+arXiv 1902.00465) over to serving:
+
+- the pool declares a **logical capacity** (``logical_replicas`` slots
+  on a logical mesh); each live replica covers its share, recomputed on
+  every membership change;
+- replica loss triggers a **resize**, not a reload: the pool generation
+  bumps (epoch-fenced like rendezvous — stale acks and stale resize
+  directives are dropped by generation compare), survivors reshard
+  their *live* params under the new layout (``elastic/reshard.py``
+  host-roundtrip ``device_put``), in-flight work re-dispatches through
+  the resolve-once ledger, and orphaned decode sessions re-prefill on
+  their new owner from the re-shipped prompt + sampling state;
+- a **respawned** incarnation announces itself (``hello``) and is
+  handed the survivors' params mirror to **adopt** — it re-joins from
+  live state, never from a cold checkpoint/export read;
+- while shrunk, admission control declares **degraded mode**
+  (``MicroBatcher.set_capacity``): load past the shrunk capacity sheds
+  proportionally with Retry-After, never silently;
+- ``drain(replica)`` is the graceful inverse: stop admission to one
+  replica, let its in-flight finish, retire it — the primitive both
+  failover and future hot-resize need.
+
+Chaos: ``serve.resize`` fires at the top of every resize attempt (a
+failed resize is retried by the next supervisor tick), ``serve.dispatch``
+and ``decode.step`` live in replicas.py / decode/scheduler.py — see
+``utils/faults.SERVE_CHAOS_SITES``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue as _queue
+import sys
+import threading
+import time
+import weakref
+
+import cloudpickle
+
+from tensorflowonspark_tpu.serving.replicas import (
+    ReplicaPool,
+    _import_qualname,
+    _Predictor,
+)
+from tensorflowonspark_tpu.utils import faults, metrics_registry, telemetry
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ElasticReplicaPool", "assign_slots", "pool_table"]
+
+BOOT_WAIT_ENV = "TFOS_SERVE_BOOT_WAIT"
+
+#: tfos_serve_resize_seconds buckets — resizes are host-roundtrip
+#: device_put + an IPC round, seconds-scale at worst, not the
+#: DEFAULT_BUCKETS_MS milliseconds ladder.
+RESIZE_BUCKETS_S = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                    1.0, 2.5, 5.0, 10.0, 30.0)
+
+#: Live elastic pools of this process, for the /statusz pool section.
+_POOLS = weakref.WeakSet()
+
+
+def pool_table():
+    """[{generation, live, capacity, ...}] for every live elastic pool
+    (obs/http.py renders this as the /statusz pool section)."""
+    rows = []
+    for pool in list(_POOLS):
+        try:
+            rows.append(pool.describe())
+        except Exception:  # noqa: BLE001 - introspection must not raise
+            logger.debug("pool describe failed", exc_info=True)
+    return rows
+
+
+def assign_slots(logical, live):
+    """Distribute ``logical`` capacity slots over the ``live`` replica
+    indices: evenly, remainder to the lowest indices — {idx: covered}.
+    Deterministic, so the driver and a replaying postmortem agree."""
+    live = sorted(live)
+    if not live:
+        return {}
+    base, rem = divmod(int(logical), len(live))
+    return {idx: base + (1 if pos < rem else 0)
+            for pos, idx in enumerate(live)}
+
+
+# -- replica-side helpers (imported lazily by replicas._replica_task) ---------
+
+def boot_wait_default():
+    return float(os.environ.get(BOOT_WAIT_ENV, "20"))
+
+
+def await_boot(inq, timeout=None):
+    """Replica-side boot gate: wait for the supervisor's directive after
+    announcing ``hello``.  Returns ``("cold",)``, ``("adopt", version,
+    params)`` or ``("stop",)``; times out to a cold boot so a pool whose
+    supervisor died mid-handshake still comes up serveable.
+
+    Non-boot messages already queued in this index's inherited inbox
+    (a dead incarnation's batches/sessions) are discarded here: every
+    in-flight entry is re-dispatched by the pool once this incarnation
+    registers ``up``, and resolve-once dedups any overlap.
+    """
+    deadline = time.monotonic() + (boot_wait_default() if timeout is None
+                                   else timeout)
+    while time.monotonic() < deadline:
+        try:
+            msg = inq.get(timeout=0.25)
+        except _queue.Empty:
+            continue
+        except Exception:  # noqa: BLE001 - manager gone: boot cold
+            break
+        if msg[0] == "boot":
+            if msg[1] == "adopt":
+                return ("adopt", msg[2], cloudpickle.loads(msg[3]))
+            return ("cold",)
+        if msg[0] == "stop":
+            return ("stop",)
+    logger.warning("no boot directive within the wait; booting cold")
+    return ("cold",)
+
+
+def adopt_predictor(payload, version, params):
+    """Build a replica predictor from ADOPTED live params.
+
+    Only the predict *symbol* is resolved from the spec/export metadata
+    (``checkpoint.load_export_meta`` — no params read); the params come
+    from the survivors' mirror.  This is the no-cold-reload path the
+    acceptance gate checks: a re-grown replica serves the version the
+    pool was serving, even if the checkpoint files are gone.
+    """
+    if params is None:
+        raise ValueError("adopt directive carried no params")
+    fn = payload.get("predict")
+    if payload.get("export_dir") and not callable(fn):
+        from tensorflowonspark_tpu.utils import checkpoint as ckpt
+
+        meta = ckpt.load_export_meta(payload["export_dir"])
+        spec = (fn if isinstance(fn, str) else None) or meta.get("predict")
+        fn = _import_qualname(spec) if spec else None
+    elif isinstance(fn, str):
+        fn = _import_qualname(fn)
+    return _Predictor(fn, params, version, payload.get("jit"))
+
+
+def params_blob(params):
+    """Cloudpickle-able host copy of live params (the supervisor's
+    adoption mirror).  jax arrays are fetched to host numpy first —
+    device buffers don't pickle across processes."""
+    if "jax" in sys.modules:
+        try:
+            from tensorflowonspark_tpu.elastic.reshard import host_fetch
+
+            params = host_fetch(params)
+        except Exception:  # noqa: BLE001 - non-jax leaves pickle as-is
+            logger.debug("host_fetch failed; pickling as-is", exc_info=True)
+    return cloudpickle.dumps(params)
+
+
+def apply_resize(pred, covered, logical):
+    """Replica-side resize: re-place live params for this incarnation's
+    share of the logical capacity; returns elapsed milliseconds.
+
+    The serving mesh is logical ``data = covered * n_local_devices``:
+    ``elastic/virtual.virtualize`` folds the surplus factor (``covered``)
+    out of the data axis exactly like the training side, and the params
+    re-place replicated under the folded mesh via ``elastic/reshard``'s
+    host-roundtrip ``device_put``.  Pure-numpy (``jit=False``) predicts
+    have host-resident params — placement is the identity there — but
+    the mesh bookkeeping still applies: ``pred.mesh_shape`` keys the
+    compile cache, so post-resize executables never reuse a stale
+    sharding.
+    """
+    t0 = time.perf_counter()
+    covered = max(1, int(covered))
+    if "jax" in sys.modules:
+        import jax
+
+        from tensorflowonspark_tpu.elastic.reshard import reshard
+        from tensorflowonspark_tpu.elastic.virtual import virtualize
+
+        devs = jax.devices()
+        layout = virtualize({"data": covered * len(devs)}, devs)
+        pred.params = reshard(pred.params, layout.replicated())
+        pred.mesh_shape = (("data", covered * len(devs)),
+                           ("devices", len(devs)))
+    else:
+        pred.mesh_shape = (("data", covered),)
+    return (time.perf_counter() - t0) * 1e3
+
+
+# -- the pool supervisor ------------------------------------------------------
+
+class ElasticReplicaPool(ReplicaPool):
+    """A ReplicaPool that degrades by resize instead of blinking out.
+
+    Rides the base pool's machinery end-to-end — engine respawn,
+    manager IPC, liveness scan, InFlightTable re-dispatch — through the
+    ``_payload``/``_handle_extra``/``_tick`` hooks; everything elastic
+    is additive, so a non-elastic pool's behavior is byte-identical.
+    """
+
+    def __init__(self, spec, num_replicas=None, logical_replicas=None,
+                 on_capacity=None, engine=None, env=None, max_retries=None,
+                 request_timeout=None):
+        super().__init__(spec, num_replicas=num_replicas, engine=engine,
+                         env=env, max_retries=max_retries,
+                         request_timeout=request_timeout)
+        self.logical_replicas = int(logical_replicas or self.num_replicas)
+        if self.logical_replicas < self.num_replicas:
+            raise ValueError(
+                f"logical_replicas={self.logical_replicas} < "
+                f"num_replicas={self.num_replicas}: the logical capacity "
+                "is the pool's full-strength shape")
+        self._on_capacity = on_capacity
+        self._el_lock = threading.RLock()
+        self.generation = 0
+        self.capacity_frac = 0.0     # no one is live until start()
+        self.resizes = 0
+        self.adoptions = 0
+        self.last_resize_s = None
+        self._assignments = {}       # idx -> covered logical slots
+        self._draining = set()
+        self._booting = {}           # idx -> dead incarnation's pid: a
+        #                              hello arrived but the new pid isn't
+        #                              registered yet, so the old one is
+        #                              dead even if the monitor's death
+        #                              scan never saw it (respawn raced it)
+        self._resized_for = None     # (idx, pid) membership signature the
+        #                              last resize covered — pid-aware, so
+        #                              a respawned incarnation (same idx,
+        #                              new pid) still triggers a resize
+        self._resize_pending = {}    # gen -> {idx awaiting ack}
+        self._resize_t0 = {}         # gen -> perf_counter at bump
+        self._mirror_version = None  # newest replica-synced params
+        self._mirror_blob = None
+
+    # -- hooks into the base pool -------------------------------------------
+    def _payload(self):
+        payload = super()._payload()
+        payload["elastic"] = {"logical": self.logical_replicas}
+        return payload
+
+    def _handle_extra(self, msg):
+        kind = msg[0]
+        if kind == "hello":
+            _, idx, pid = msg
+            # a hello from an index with a *recorded* prior incarnation
+            # proves that incarnation is dead, even when the engine's
+            # respawn beat the monitor's death scan (so the live set
+            # never visibly shrank): shrink NOW so the degraded window
+            # is declared, not skipped.  The exclusion is keyed to the
+            # DEAD pid and dissolves by itself once the new incarnation
+            # registers up (different pid) — no up-ordering race.
+            # First-formation hellos (no pid on record yet) don't
+            # resize; start() forms the pool once.
+            dead_pid = self._table.pids().get(idx)
+            if dead_pid is not None:
+                with self._el_lock:
+                    self._booting[idx] = dead_pid
+                self._maybe_resize(f"replica {idx} rebooting")
+            with self._el_lock:
+                blob = self._mirror_blob
+                version = self._mirror_version
+            if blob is not None:
+                self.adoptions += 1
+                telemetry.event("serve/pool_adopt", replica=idx,
+                                version=version)
+                directive = ("boot", "adopt", version, blob)
+            else:
+                directive = ("boot", "cold")
+            try:
+                self._inqs[idx].put(directive)
+            except Exception:  # noqa: BLE001 - it will boot cold on timeout
+                logger.warning("boot directive to replica %s failed", idx)
+            return True
+        if kind == "params_sync":
+            _, idx, version, blob = msg
+            with self._el_lock:
+                if self._mirror_version is None \
+                        or version >= self._mirror_version:
+                    self._mirror_version, self._mirror_blob = version, blob
+            return True
+        if kind == "resized":
+            _, idx, gen, covered, replica_ms = msg
+            with self._el_lock:
+                if gen != self.generation:
+                    return True  # stale ack: epoch-fenced, dropped
+                pending = self._resize_pending.get(gen)
+                if pending is None:
+                    return True
+                pending.discard(idx)
+                if pending:
+                    return True
+                del self._resize_pending[gen]
+                dur = time.perf_counter() - self._resize_t0.pop(gen)
+                self.last_resize_s = dur
+            metrics_registry.observe("tfos_serve_resize_seconds", dur,
+                                     buckets=RESIZE_BUCKETS_S)
+            telemetry.event("serve/pool_resized", generation=gen,
+                            seconds=round(dur, 4))
+            return True
+        if kind == "resize_error":
+            _, idx, gen, err = msg
+            logger.warning("replica %s failed resize gen %s: %s",
+                           idx, gen, err)
+            telemetry.event("serve/pool_resize_error", replica=idx,
+                            generation=gen, error=str(err)[:200])
+            with self._el_lock:
+                self._resized_for = None  # next tick re-resizes (new gen)
+            return True
+        return False
+
+    def _tick(self):
+        self._maybe_resize("membership changed")
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self, timeout=180.0):
+        super().start(timeout=timeout)
+        _POOLS.add(self)
+        # deterministic initial formation: don't wait for the first
+        # monitor tick to hand out assignments
+        self._maybe_resize("formed")
+        return self
+
+    def stop(self):
+        _POOLS.discard(self)
+        super().stop()
+
+    # -- resize choreography -------------------------------------------------
+    def _maybe_resize(self, reason):
+        with self._el_lock:
+            pids = self._table.pids()
+            # a booting exclusion holds only while the table still shows
+            # the dead incarnation's pid; the new up dissolves it
+            for i in [i for i, p in self._booting.items()
+                      if pids.get(i) != p]:
+                del self._booting[i]
+            live = tuple(i for i in self._table.live()
+                         if i not in self._draining
+                         and i not in self._booting)
+            # pid-aware signature: a respawned incarnation (same index,
+            # new pid) is a membership change even though the index set
+            # looks identical — it must be handed its assignment
+            sig = tuple((i, pids.get(i)) for i in live)
+            if sig == self._resized_for:
+                return
+            if not live:
+                # nothing to resize onto: declare zero capacity (the
+                # batcher sheds everything, explicitly) and wait for a
+                # respawn to change the membership again
+                self._resized_for = sig
+                self._apply_capacity(0.0)
+                return
+            try:
+                self._resize(live, reason)
+                self._resized_for = sig
+            except Exception as e:  # noqa: BLE001 - incl. injected faults
+                logger.warning("pool resize (%s) failed; next tick "
+                               "retries: %s", reason, e)
+
+    def _resize(self, live, reason):
+        """One generation bump: fence, assign, reshard, re-admit."""
+        faults.check("serve.resize", reason=reason, live=len(live))
+        t0 = time.perf_counter()
+        self.generation += 1
+        gen = self.generation
+        self._assignments = assign_slots(self.logical_replicas, live)
+        frac = min(1.0, len(live) / float(self.logical_replicas))
+        self.resizes += 1
+        metrics_registry.set_gauge("tfos_serve_pool_generation", gen)
+        telemetry.event("serve/pool_resize", generation=gen, reason=reason,
+                        live=list(live), capacity=round(frac, 4),
+                        assignments={str(k): v for k, v
+                                     in sorted(self._assignments.items())})
+        try:  # black-box the degrade/regrow event for tfos-postmortem
+            from tensorflowonspark_tpu.obs import flight as _flight
+
+            _flight.snapshot("serve/pool_resize", node="serve-pool",
+                             reason=f"{reason}: gen {gen} -> {list(live)}",
+                             inflight=self._inflight_summary())
+        except Exception:  # noqa: BLE001 - never block a resize
+            logger.debug("flight snapshot failed", exc_info=True)
+        self._resize_pending[gen] = set(live)
+        self._resize_t0[gen] = t0
+        # older generations can never complete now — drop their fences
+        for old in [g for g in self._resize_pending if g < gen]:
+            self._resize_pending.pop(old, None)
+            self._resize_t0.pop(old, None)
+        for idx in live:
+            covered = self._assignments.get(idx, 0)
+            try:
+                self._inqs[idx].put(
+                    ("resize", gen, covered, self.logical_replicas))
+            except Exception:  # noqa: BLE001 - death races the directive;
+                # the next membership change re-resizes
+                logger.warning("resize directive to replica %s failed", idx)
+        self._apply_capacity(frac)
+
+    def _apply_capacity(self, frac):
+        self.capacity_frac = frac
+        degraded = frac < 1.0
+        metrics_registry.set_gauge("tfos_serve_pool_degraded",
+                                   1.0 if degraded else 0.0)
+        if self._on_capacity is not None:
+            try:
+                self._on_capacity(frac, self.generation, degraded)
+            except Exception:  # noqa: BLE001 - admission hook must not
+                # wedge the supervisor
+                logger.exception("on_capacity hook failed")
+
+    @property
+    def degraded(self):
+        return self.capacity_frac < 1.0
+
+    # -- graceful drain ------------------------------------------------------
+    def drain(self, idx, timeout=30.0):
+        """Gracefully retire replica ``idx``: stop admission to it
+        (InFlightTable quiesce), resize its capacity share away, let its
+        in-flight work finish (re-dispatching whatever remains at the
+        deadline), then stop it.  Terminal: a drained replica's engine
+        task returns cleanly and is not respawned.  True when the
+        replica left the live set within ``timeout``."""
+        idx = int(idx)
+        live = self._table.live()
+        if idx not in live:
+            raise ValueError(f"replica {idx} is not live ({live})")
+        if set(live) - self._draining <= {idx}:
+            raise ValueError("cannot drain the last live replica")
+        telemetry.event("serve/pool_drain", replica=idx)
+        self._draining.add(idx)
+        self._table.quiesce(idx)
+        self._maybe_resize(f"drain {idx}")
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if not self._table.owned_count(idx):
+                break
+            time.sleep(0.05)
+        else:
+            # still holding work at the deadline: hand it to survivors
+            # (resolve-once dedups any straggling double answer)
+            self._redispatch({idx})
+        try:
+            self._inqs[idx].put(("stop",))
+        except Exception:  # noqa: BLE001 - already gone
+            pass
+        while time.monotonic() < deadline and idx in self._table.live():
+            time.sleep(0.05)
+        return idx not in self._table.live()
+
+    # -- introspection -------------------------------------------------------
+    def describe(self):
+        with self._el_lock:
+            return {
+                "generation": self.generation,
+                "logical": self.logical_replicas,
+                "live": self._table.live(),
+                "draining": sorted(self._draining),
+                "capacity": round(self.capacity_frac, 4),
+                "degraded": self.degraded,
+                "resizes": self.resizes,
+                "adoptions": self.adoptions,
+                "last_resize_ms": (round(self.last_resize_s * 1e3, 3)
+                                   if self.last_resize_s is not None
+                                   else None),
+                "assignments": {str(k): v for k, v
+                                in sorted(self._assignments.items())},
+            }

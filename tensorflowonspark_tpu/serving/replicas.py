@@ -44,7 +44,7 @@ import numpy as np
 from tensorflowonspark_tpu import manager as tfmanager
 from tensorflowonspark_tpu.actors import liveness
 from tensorflowonspark_tpu.actors.dispatch import InFlightTable
-from tensorflowonspark_tpu.utils import metrics_registry, telemetry
+from tensorflowonspark_tpu.utils import faults, metrics_registry, telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -141,14 +141,18 @@ class _Predictor:
         self._jit = jit_mode
         self._compiled = {}
         self.compiles = {}           # sig str -> compile count
+        self.mesh_shape = None       # set by an elastic resize
         self.batches = 0
         self.rows = 0
         self.device_ms = 0.0
 
-    @staticmethod
-    def _sig(inputs):
-        return tuple((k, tuple(v.shape), str(v.dtype))
-                     for k, v in sorted(inputs.items()))
+    def _sig(self, inputs):
+        # keyed by (mesh shape, shapes/dtypes): after an elastic reshard
+        # the same bucket must re-lower — reusing an executable against a
+        # stale sharding would be a silent wrong-placement
+        return (self.mesh_shape,) + tuple(
+            (k, tuple(v.shape), str(v.dtype))
+            for k, v in sorted(inputs.items()))
 
     def _lower(self, inputs):
         if self._jit is False:
@@ -275,9 +279,31 @@ def _make_replica_task(payload_blob, mgr_addr, mgr_authkey):
         inq = mgr.get_queue(_in_queue(idx))
         outq = mgr.get_queue(OUT_QUEUE)
         telemetry.configure(node_id=f"replica-{idx}", role="serving")
+        _elastic = None
+        el_state = {"gen": 0, "covered": None, "resizes": 0, "boot": "cold"}
         try:
             payload = cloudpickle.loads(payload_blob)
-            pred = _resolve_predictor(payload)
+            elastic_cfg = payload.get("elastic")
+            if elastic_cfg:
+                # elastic boot gate (serving/elastic.py): announce this
+                # incarnation, then wait for the supervisor's directive —
+                # "cold" (load from the spec) or "adopt" (live params
+                # resharded from the survivors' mirror, never a
+                # checkpoint reload)
+                from tensorflowonspark_tpu.serving import elastic as _elastic
+
+                outq.put(("hello", idx, os.getpid()))
+                boot = _elastic.await_boot(inq)
+                if boot[0] == "stop":
+                    outq.put(("down", idx))
+                    return
+                if boot[0] == "adopt":
+                    pred = _elastic.adopt_predictor(payload, boot[1], boot[2])
+                    el_state["boot"] = "adopted"
+                else:
+                    pred = _resolve_predictor(payload)
+            else:
+                pred = _resolve_predictor(payload)
             engine = None
             if payload.get("decode") is not None:
                 from tensorflowonspark_tpu.serving.decode.scheduler import (
@@ -298,6 +324,11 @@ def _make_replica_task(payload_blob, mgr_addr, mgr_authkey):
         stop_beat = liveness.start_heartbeat(
             mgr, HEARTBEAT_PREFIX + str(idx))
         outq.put(("up", idx, os.getpid(), pred.version))
+        if elastic_cfg and el_state["boot"] == "cold":
+            # seed the supervisor's params mirror so the NEXT incarnation
+            # can adopt instead of cold-loading
+            outq.put(("params_sync", idx, pred.version,
+                      _elastic.params_blob(pred.params)))
         try:
             while True:
                 try:
@@ -309,18 +340,39 @@ def _make_replica_task(payload_blob, mgr_addr, mgr_authkey):
                     break
                 if kind == "reload":
                     try:
-                        if payload.get("ckpt_dir"):
-                            if _maybe_reload(pred, payload["ckpt_dir"]) \
-                                    and engine is not None:
+                        if payload.get("ckpt_dir") \
+                                and _maybe_reload(pred, payload["ckpt_dir"]):
+                            if engine is not None:
                                 engine.set_params(pred.params)
+                            if elastic_cfg:
+                                outq.put(("params_sync", idx, pred.version,
+                                          _elastic.params_blob(pred.params)))
                         outq.put(("reloaded", idx, pred.version))
                     except Exception as e:  # noqa: BLE001 - keep serving
                         logger.exception("reload failed")
                         outq.put(("reload_error", idx, repr(e)))
+                elif kind == "resize":
+                    _, gen, covered, logical = msg
+                    if gen <= el_state["gen"]:
+                        continue  # stale generation: epoch-fenced
+                    try:
+                        ms = _elastic.apply_resize(pred, covered, logical)
+                        el_state.update(gen=gen, covered=covered,
+                                        resizes=el_state["resizes"] + 1)
+                        if engine is not None:
+                            engine.set_params(pred.params)
+                        outq.put(("resized", idx, gen, covered, ms))
+                    except Exception as e:  # noqa: BLE001 - keep serving
+                        # on the previous layout; the supervisor retries
+                        logger.exception("resize to covered=%s failed",
+                                         covered)
+                        outq.put(("resize_error", idx, gen, repr(e)))
                 elif kind == "stats":
                     st = pred.stats()
                     if engine is not None:
                         st["decode"] = engine.stats()
+                    if elastic_cfg:
+                        st["elastic"] = dict(el_state)
                     outq.put(("stats", idx, st))
                 elif kind == "gen":
                     _, sid, blob = msg
@@ -410,7 +462,7 @@ class ReplicaPool:
                       for i in range(self.num_replicas)}
         self._outq = self._mgr.get_queue(OUT_QUEUE)
         task = _make_replica_task(
-            cloudpickle.dumps(self.spec.to_payload()),
+            cloudpickle.dumps(self._payload()),
             tuple(self._mgr.address), authkey)
 
         def _launch():
@@ -473,10 +525,16 @@ class ReplicaPool:
             except Exception:  # noqa: BLE001
                 pass
 
+    def _payload(self):
+        """Replica task payload hook (the elastic pool subclass rides it
+        to ship its logical-capacity config alongside the ModelSpec)."""
+        return self.spec.to_payload()
+
     # -- dispatch ------------------------------------------------------------
     def dispatch(self, batch):
         """Send one batcher Batch to the least-loaded live replica.
         Called from the batcher thread; must not block on the device."""
+        faults.check("serve.dispatch", what="batch", id=batch.id)
         if self._job_error is not None and not self._table.live():
             raise RuntimeError(
                 f"no replicas left (job failed: {self._job_error})")
@@ -492,6 +550,7 @@ class ReplicaPool:
         (full re-prefill there), and the session's index-keyed ledger
         plus resolve-once ``_set`` make the replay zero-drop/zero-dup.
         """
+        faults.check("serve.dispatch", what="gen", id=session.id)
         if self.spec.decode is None:
             raise RuntimeError("spec has no decode engine; pass "
                                "ModelSpec(..., decode=DecodeSpec(...))")
@@ -531,6 +590,8 @@ class ReplicaPool:
                 continue
             except Exception:  # noqa: BLE001 - manager shut down
                 return
+            if self._handle_extra(msg):
+                continue
             kind = msg[0]
             if kind == "up":
                 _, idx, pid, version = msg
@@ -600,12 +661,26 @@ class ReplicaPool:
                 logger.warning("replica %s reported %s: %s",
                                msg[1], kind, msg[2])
 
+    def _handle_extra(self, msg):
+        """Subclass hook, called before the base message chain: consume
+        pool-specific out-queue traffic (the elastic pool's boot/mirror/
+        resize-ack messages).  True when the message was handled."""
+        return False
+
+    def _tick(self):
+        """Subclass hook, called once per monitor pass (the elastic pool
+        rides it to reconcile membership against its assignments)."""
+
     def _monitor(self):
         """Failure detection: executor-process death (fast path) and
         stale manager-KV heartbeats (wedged-replica path).  Either way
         the replica's in-flight batches are re-dispatched to survivors
         (Batch resolves once, so duplicated answers are no-ops)."""
         while not self._stop.wait(0.2):
+            try:
+                self._tick()
+            except Exception:  # noqa: BLE001 - next pass retries
+                logger.exception("pool tick failed")
             now = time.monotonic()
             dead = liveness.scan(self._table.live(), self._proc_alive,
                                  self._beat_age, tfmanager.stale_after())

@@ -195,7 +195,8 @@ class Server:
     def __init__(self, spec, num_replicas=None, max_batch=None,
                  max_delay_ms=None, queue_max=None, engine=None, env=None,
                  request_timeout=None, decode_queue_max=None,
-                 seq_axis=None, seq_cap=None):
+                 seq_axis=None, seq_cap=None, elastic=False,
+                 logical_replicas=None):
         self.spec = spec
         self.stats = SLOStats()
         self.decode_stats = DecodeStats()
@@ -203,9 +204,24 @@ class Server:
                                 or _batcher.request_timeout_default())
         self.decode_queue_max = (decode_queue_max
                                  or _decode.queue_max_default())
-        self.pool = ReplicaPool(
-            spec, num_replicas=num_replicas, engine=engine, env=env,
-            request_timeout=self.request_timeout)
+        # decode admission scales with elastic pool capacity the same
+        # way the batcher's queue bound does (docs/serving.md "Degrade
+        # by resize"); 1.0 until the pool reports otherwise
+        self._decode_capacity = 1.0
+        if elastic or logical_replicas:
+            from tensorflowonspark_tpu.serving.elastic import (
+                ElasticReplicaPool,
+            )
+
+            self.pool = ElasticReplicaPool(
+                spec, num_replicas=num_replicas,
+                logical_replicas=logical_replicas, engine=engine, env=env,
+                request_timeout=self.request_timeout,
+                on_capacity=self._on_capacity)
+        else:
+            self.pool = ReplicaPool(
+                spec, num_replicas=num_replicas, engine=engine, env=env,
+                request_timeout=self.request_timeout)
         self.batcher = MicroBatcher(
             self.pool.dispatch, max_batch=max_batch,
             max_delay_ms=max_delay_ms, queue_max=queue_max,
@@ -235,6 +251,14 @@ class Server:
         self.stats.observe_shed()
         metrics_registry.inc("tfos_serve_requests_total", status="shed")
         telemetry.event(telemetry.SERVE_SHED, depth=depth, limit=limit)
+
+    def _on_capacity(self, frac, generation, degraded):
+        """Elastic pool capacity hook: the declared degraded mode —
+        admission shrinks with the pool, sheds stay explicit."""
+        self.batcher.set_capacity(frac)
+        self._decode_capacity = frac
+        telemetry.event("serve/capacity", capacity=round(frac, 4),
+                        generation=generation, degraded=degraded)
 
     # -- lifecycle ----------------------------------------------------------
     def start(self, timeout=180.0):
@@ -328,12 +352,16 @@ class Server:
     def _generate_traced(self, prompt, max_tokens, eos_id, timeout,
                          sampling):
         depth = self.pool.outstanding_sessions()
-        if depth >= self.decode_queue_max:
+        limit = max(1, int(round(self.decode_queue_max
+                                 * self._decode_capacity))) \
+            if self._decode_capacity > 0 else 0
+        if depth >= limit:
             self.decode_stats.observe_shed()
             metrics_registry.inc("tfos_decode_sessions_total", status="shed")
-            telemetry.event(telemetry.DECODE_SHED, depth=depth,
-                            limit=self.decode_queue_max)
-            raise Overloaded(depth, self.decode_queue_max)
+            telemetry.event(telemetry.DECODE_SHED, depth=depth, limit=limit)
+            raise Overloaded(depth, limit,
+                             retry_after=0.25 if self._decode_capacity < 1.0
+                             else 0.1)
         ctx = telemetry.current()
         session = _decode.PendingSession(
             next(self._session_ids), prompt,
@@ -379,6 +407,8 @@ class Server:
         out["versions"] = self.pool.versions()
         if self.spec.decode is not None:
             out["decode"] = self.decode_stats.summary()
+        if hasattr(self.pool, "describe"):
+            out["pool"] = self.pool.describe()
         if include_replicas:
             out["replica_stats"] = self.pool.stats()
         return out
@@ -427,8 +457,16 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/healthz":
             live = srv.pool.live_replicas()
             code = 200 if live else 503
-            self._reply(code, {"status": "ok" if live else "degraded",
-                               "replicas": live})
+            # an elastic pool below logical capacity is alive-but-
+            # degraded: still 200 (load balancers keep routing), status
+            # says so, and the generation/capacity ride along
+            degraded = (not live) or getattr(srv.pool, "degraded", False)
+            body = {"status": "degraded" if degraded else "ok",
+                    "replicas": live}
+            if hasattr(srv.pool, "generation"):
+                body["generation"] = srv.pool.generation
+                body["capacity"] = round(srv.pool.capacity_frac, 4)
+            self._reply(code, body)
         elif self.path == "/stats":
             self._reply(200, srv.summary())
         else:
@@ -553,6 +591,12 @@ def build_parser():
     p.add_argument("--max_batch", type=int, default=None)
     p.add_argument("--max_delay_ms", type=float, default=None)
     p.add_argument("--queue_max", type=int, default=None)
+    p.add_argument("--elastic", action="store_true",
+                   help="degrade-by-resize pool (docs/serving.md "
+                        "'Degrade by resize')")
+    p.add_argument("--logical_replicas", type=int, default=None,
+                   help="logical capacity for --elastic "
+                        "(default: num_replicas)")
     return p
 
 
@@ -566,7 +610,9 @@ def main(argv=None):
     server = Server(spec, num_replicas=args.num_replicas,
                     max_batch=args.max_batch,
                     max_delay_ms=args.max_delay_ms,
-                    queue_max=args.queue_max)
+                    queue_max=args.queue_max,
+                    elastic=args.elastic,
+                    logical_replicas=args.logical_replicas)
     server.start()
     logger.info("serving on http://%s:%d (POST /v1/predict)",
                 args.host, args.port)

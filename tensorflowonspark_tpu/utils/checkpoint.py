@@ -129,8 +129,14 @@ def load_exported(export_dir):
     with _fs.open_file(_fs.join(export_dir, "params.npz"), "rb") as f, \
             np.load(f) as z:
         params = _unflatten({k: z[k] for k in z.files})
-    meta = json.loads(_fs.read_bytes(_fs.join(export_dir, "export.json")))
-    return params, meta
+    return params, load_export_meta(export_dir)
+
+
+def load_export_meta(export_dir):
+    """Export metadata alone, no params read: the elastic adopt path
+    (serving/elastic.py) resolves the predict symbol from it while the
+    params arrive live from a surviving replica."""
+    return json.loads(_fs.read_bytes(_fs.join(export_dir, "export.json")))
 
 
 def is_chief(ctx):

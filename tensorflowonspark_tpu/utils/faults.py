@@ -72,6 +72,9 @@ SITES = (
     "actor.spawn",          # actors/runtime.py member boot, before on_start
     "actor.receive",        # actors/runtime.py, before handling an envelope
     "actor.tick",           # actors/runtime.py idle tick, before on_tick
+    "serve.dispatch",       # serving/replicas.py, before routing a request
+    "serve.resize",         # serving/elastic.py, before a pool resize
+    "decode.step",          # serving/decode/scheduler.py engine loop body
 )
 
 #: Sites whose hit counters live in long-lived executor processes, so a
@@ -80,6 +83,13 @@ SITES = (
 #: sites (feed.get, node.main, checkpoint.save) restart their counters in
 #: every relaunched fork child and would re-fire forever.
 CHAOS_SITES = ("engine.task", "node.boot", "feed.put", "rendezvous.query")
+
+#: Serving-tier counterpart for the elastic-pool chaos smoke: dispatch
+#: faults surface as explicit client errors (batcher fails the batch),
+#: resize faults are retried by the next supervisor tick, decode faults
+#: fail the cohort and rebuild the caches — all recoverable, so a
+#: randomized plan over these must leave the pool serving.
+SERVE_CHAOS_SITES = ("serve.dispatch", "serve.resize", "decode.step")
 
 
 class FaultInjected(RuntimeError):

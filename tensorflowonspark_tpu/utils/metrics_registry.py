@@ -116,6 +116,15 @@ CATALOG = {
         "counter", "Real (non-padding) rows in dispatched batches."),
     "tfos_serve_reloads_total": (
         "counter", "Checkpoint hot-reload broadcasts."),
+    "tfos_serve_pool_generation": (
+        "gauge", "Elastic pool generation (bumps on every resize; "
+                 "epoch-fences stale resize acks)."),
+    "tfos_serve_pool_degraded": (
+        "gauge", "1 while the elastic pool serves below its logical "
+                 "capacity, else 0."),
+    "tfos_serve_resize_seconds": (
+        "histogram", "Elastic pool resize duration (generation bump to "
+                     "last replica reshard ack), seconds."),
     # decode (serving/decode/ — server process + replica engines)
     "tfos_decode_sessions_total": (
         "counter", "Decode sessions, by status (ok|error|shed)."),

@@ -88,6 +88,7 @@ def test_dead_relay_ignore_env_presses_on():
         TFOS_BENCH_FED="0", TFOS_BENCH_TRANSFORMER="0",
         TFOS_BENCH_TFRECORD_READ="0", TFOS_BENCH_SEGMENTATION="0",
         TFOS_BENCH_BATCH_INFERENCE="0", TFOS_BENCH_SERVE="0",
+        TFOS_BENCH_ELASTIC_SERVE="0",
         TFOS_BENCH_DECODE="0", TFOS_BENCH_DATA="0",
         TFOS_BENCH_ELASTIC="0", TFOS_BENCH_ACTORS="0",
         TFOS_BENCH_STEPS="1",
@@ -116,7 +117,8 @@ def test_fed_lane_vs_device_resident_regression():
         PYTHONPATH="", JAX_PLATFORMS="cpu",
         TFOS_BENCH_TRANSFORMER="0", TFOS_BENCH_TFRECORD_READ="0",
         TFOS_BENCH_SEGMENTATION="0", TFOS_BENCH_BATCH_INFERENCE="0",
-        TFOS_BENCH_SERVE="0", TFOS_BENCH_DECODE="0",
+        TFOS_BENCH_SERVE="0", TFOS_BENCH_ELASTIC_SERVE="0",
+        TFOS_BENCH_DECODE="0",
         TFOS_BENCH_DATA="0", TFOS_BENCH_ELASTIC="0",
         TFOS_BENCH_ACTORS="0",
         TFOS_BENCH_FED_AB="0",  # one lane is enough for the gate

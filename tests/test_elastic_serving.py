@@ -1,0 +1,373 @@
+"""Elastic serving tier (serving/elastic.py, docs/serving.md "Degrade by
+resize"): slot-assignment math, pid-keyed compile-cache invalidation,
+declared degraded admission (proportional shed + Retry-After floor),
+quiesced dispatch, the boot/adopt handshake helpers, graceful drain, and
+the new chaos sites.  Slow lane: the acceptance e2e — SIGKILL one of two
+elastic replicas under live predict+decode traffic, assert zero
+dropped/duplicated work, a declared degraded window, and a re-grow that
+ADOPTS the survivors' live params (checkpoint files already deleted) —
+plus a seeded chaos smoke over ``faults.SERVE_CHAOS_SITES``."""
+
+import os
+import queue as _queue
+import threading
+import time
+
+import cloudpickle
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.actors.dispatch import InFlightTable
+from tensorflowonspark_tpu.serving import batcher as B
+from tensorflowonspark_tpu.serving import elastic as E
+from tensorflowonspark_tpu.serving import replicas as R
+from tensorflowonspark_tpu.serving import server as S
+from tensorflowonspark_tpu.utils import faults
+
+pytestmark = pytest.mark.serve
+
+
+def _double_predict(params, inputs):
+    del params
+    return {"y": inputs["x"] * 2.0}
+
+
+def _echo_version(params, inputs):
+    n = inputs["x"].shape[0]
+    return {"version": np.full((n,), float(params["version"]), np.float32)}
+
+
+# --- slot assignment ---------------------------------------------------------
+
+def test_assign_slots_even_with_remainder():
+    assert E.assign_slots(4, [0, 1]) == {0: 2, 1: 2}
+    # remainder goes to the lowest indices, deterministically
+    assert E.assign_slots(5, [2, 0, 1]) == {0: 2, 1: 2, 2: 1}
+    assert E.assign_slots(3, [1]) == {1: 3}
+    assert E.assign_slots(3, []) == {}
+    covered = E.assign_slots(7, [0, 1, 2])
+    assert sum(covered.values()) == 7
+
+
+# --- quiesced dispatch (drain primitive) ------------------------------------
+
+def test_inflight_quiesce_and_owned_count():
+    t = InFlightTable(pool_size=2)
+    t.up(0, 100)
+    t.up(1, 101)
+    t.quiesce(0)
+    for i in range(4):
+        owner = t.add(("batch", i), {"blob": b""})
+        assert owner == 1  # quiesced member takes no NEW work
+    assert t.owned_count(1) == 4 and t.owned_count(0) == 0
+    # when every live member is draining they still beat a blind guess
+    t.quiesce(1)
+    assert t.add(("batch", 9), {"blob": b""}) in (0, 1)
+    t.unquiesce(0)
+    t.pop(("batch", 9))
+    assert t.add(("batch", 10), {"blob": b""}) == 0
+
+
+# --- compile cache keyed by mesh shape (small-fix satellite) ----------------
+
+def test_predictor_compile_cache_keyed_by_mesh_shape():
+    pred = R._Predictor(_double_predict, {}, 0, False)
+    x = {"x": np.ones((4, 2), np.float32)}
+    pred(x)
+    pred(x)
+    assert len(pred.compiles) == 1  # same bucket, same mesh: one entry
+    # an elastic resize changes the mesh shape: the same bucket must
+    # key a NEW executable (stale-sharding reuse would be silent
+    # wrong-placement)
+    before = pred.mesh_shape
+    ms = E.apply_resize(pred, covered=3, logical=4)
+    assert isinstance(ms, float) and ms >= 0
+    assert pred.mesh_shape is not None and pred.mesh_shape != before
+    pred(x)
+    assert len(pred.compiles) == 2
+    # resizing to a different share re-keys again
+    E.apply_resize(pred, covered=1, logical=4)
+    pred(x)
+    assert len(pred.compiles) == 3
+
+
+# --- declared degraded admission --------------------------------------------
+
+def test_batcher_capacity_scales_shed_with_retry_after_floor():
+    sheds = []
+    mb = B.MicroBatcher(lambda b: None, max_batch=8, max_delay_ms=5,
+                        queue_max=4,
+                        on_shed=lambda d, l: sheds.append((d, l)))
+    # never started: nothing drains, so queue depth is deterministic
+    assert not mb.degraded and mb.effective_queue_max() == 4
+    mb.set_capacity(0.5)
+    assert mb.degraded and mb.effective_queue_max() == 2
+    mb.submit({"x": np.ones(1)})
+    mb.submit({"x": np.ones(1)})
+    with pytest.raises(B.Overloaded) as ei:
+        mb.submit({"x": np.ones(1)})
+    assert ei.value.limit == 2
+    # degraded sheds tell clients to come back AFTER the resize window,
+    # not after one batch flush
+    assert ei.value.retry_after >= 0.25
+    assert sheds == [(ei.value.depth, 2)]
+    # capacity 0: shed everything, explicitly (pool has no live replica)
+    mb.set_capacity(0.0)
+    assert mb.effective_queue_max() == 0
+    # the bound never rounds below 1 while ANY capacity remains
+    mb.set_capacity(0.01)
+    assert mb.effective_queue_max() == 1
+    mb.set_capacity(1.0)
+    assert not mb.degraded and mb.effective_queue_max() == 4
+    mb.close()
+
+
+# --- boot/adopt handshake helpers -------------------------------------------
+
+def test_await_boot_directives_and_timeout():
+    q = _queue.Queue()
+    q.put(("batch", 1, b"stale"))  # inherited inbox junk is discarded
+    q.put(("boot", "adopt", 7, cloudpickle.dumps({"w": 3})))
+    assert E.await_boot(q, timeout=5) == ("adopt", 7, {"w": 3})
+    q.put(("boot", "cold"))
+    assert E.await_boot(q, timeout=5) == ("cold",)
+    q.put(("stop",))
+    assert E.await_boot(q, timeout=5) == ("stop",)
+    # no directive: boot cold rather than wedge the replica
+    assert E.await_boot(_queue.Queue(), timeout=0.3) == ("cold",)
+
+
+def test_adopt_predictor_uses_mirror_not_disk():
+    payload = {"predict": _echo_version, "jit": False}
+    pred = E.adopt_predictor(payload, 7, {"version": 7.0})
+    assert pred.version == 7
+    out, _ms = pred({"x": np.ones((2, 1), np.float32)})
+    assert out["version"] == pytest.approx([7.0, 7.0])
+    with pytest.raises(ValueError):
+        E.adopt_predictor(payload, 7, None)
+
+
+def test_elastic_pool_validates_logical_capacity():
+    spec = R.ModelSpec(predict=_double_predict, params={}, jit=False)
+    with pytest.raises(ValueError):
+        E.ElasticReplicaPool(spec, num_replicas=2, logical_replicas=1)
+
+
+# --- chaos sites (satellite) ------------------------------------------------
+
+@pytest.mark.faults
+def test_serve_chaos_sites_registered_and_fire(monkeypatch):
+    assert set(faults.SERVE_CHAOS_SITES) <= set(faults.SITES)
+    plan = faults.random_plan(123, sites=faults.SERVE_CHAOS_SITES)
+    assert any(s in plan for s in faults.SERVE_CHAOS_SITES)
+    monkeypatch.setenv("TFOS_FAULT_PLAN", "serve.dispatch:exc@2")
+    faults._reset_for_tests()
+    try:
+        faults.check("serve.dispatch", what="batch")       # hit 1: armed @2
+        with pytest.raises(faults.FaultInjected):
+            faults.check("serve.dispatch", what="batch")   # hit 2: fires
+        faults.check("serve.resize", reason="formed")      # other site: quiet
+        faults.check("decode.step", replica=0)
+    finally:
+        monkeypatch.delenv("TFOS_FAULT_PLAN")
+        faults._reset_for_tests()
+
+
+# --- graceful drain (in-process, real replicas) -----------------------------
+
+def test_drain_degrades_and_refuses_last_replica():
+    spec = R.ModelSpec(predict=_double_predict, params={}, jit=False)
+    with S.Server(spec, num_replicas=2, max_batch=8, max_delay_ms=5,
+                  elastic=True) as srv:
+        c = srv.client()
+        c.predict({"x": np.ones(2, np.float32)}, timeout=60)
+        assert srv.pool.generation >= 1 and not srv.pool.degraded
+        assert srv.pool.capacity_frac == pytest.approx(1.0)
+        assert sum(srv.pool._assignments.values()) == 2
+
+        assert srv.pool.drain(0, timeout=30) is True
+        assert srv.pool.live_replicas() == [1]
+        assert srv.pool.degraded
+        assert srv.pool.capacity_frac == pytest.approx(0.5)
+        assert srv.pool.generation >= 2
+        assert srv.batcher.degraded  # admission follows the pool
+        # the survivor keeps serving
+        out = c.predict({"x": np.full(2, 3.0, np.float32)}, timeout=60)
+        assert out["y"] == pytest.approx([6.0, 6.0])
+
+        desc = srv.summary()["pool"]
+        assert desc["degraded"] and desc["live"] == [1]
+        assert 0 in desc["draining"] or "0" not in desc["assignments"]
+        assert [row["generation"] for row in E.pool_table()
+                if row["live"] == [1]]
+        with pytest.raises(ValueError):
+            srv.pool.drain(1)  # never drain the last live replica
+        with pytest.raises(ValueError):
+            srv.pool.drain(5)  # not live at all
+
+
+# --- slow lane: the acceptance e2e ------------------------------------------
+
+def _cfg():
+    from tensorflowonspark_tpu.models import transformer as T
+    return T.Config(vocab_size=61, dim=32, n_layers=2, n_heads=2,
+                    max_seq=32, dtype="float32", attn_impl="reference")
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_elastic_sigkill_adopt_regrow_zero_drop(tmp_path, monkeypatch):
+    """SIGKILL one of two elastic replicas under live predict+decode
+    traffic.  Asserts: zero dropped/duplicated work (predicts exact,
+    decode token streams oracle-identical), a declared degraded window,
+    generation bumps for shrink AND regrow, and — with the checkpoint
+    files deleted before the kill — the respawned replica ADOPTS the
+    survivors' live params at the original version (no cold reload)."""
+    import functools
+    import shutil
+
+    import jax
+
+    from tensorflowonspark_tpu import ops
+    from tensorflowonspark_tpu.models import transformer as T
+    from tensorflowonspark_tpu.serving import decode as D
+    from tensorflowonspark_tpu.utils import checkpoint as ckpt
+
+    cfg = _cfg()
+    params = T.init(jax.random.PRNGKey(0), cfg)
+    ckpt_dir = str(tmp_path / "ckpts")
+    ckpt.save_checkpoint(ckpt_dir, params, step=7)
+    monkeypatch.setenv("TFOS_SERVE_RELOAD_SECS", "3600")  # watcher idles
+    spec = R.ModelSpec(predict=_double_predict, ckpt_dir=ckpt_dir,
+                       jit=False,
+                       decode=D.DecodeSpec(cfg, slots=4, max_tokens=16))
+    prompt = [2, 3, 5, 7]
+    oracle = T.greedy_decode_reference(
+        params, prompt, cfg,
+        attn_fn=functools.partial(ops.mha_reference, causal=True),
+        max_tokens=12)
+
+    with S.Server(spec, num_replicas=2, elastic=True, max_batch=8,
+                  max_delay_ms=5, queue_max=10_000,
+                  request_timeout=300) as srv:
+        c = srv.client()
+        c.predict({"x": np.ones(2, np.float32)}, timeout=300)
+        srv.generate(prompt, max_tokens=2, timeout=300)   # warm compiles
+        assert set(srv.pool.versions().values()) == {7}
+        gen0 = srv.pool.generation
+        assert gen0 >= 1
+
+        # the no-cold-reload proof: after this, step 7 exists ONLY as
+        # the survivors' live params + the pool's adoption mirror
+        shutil.rmtree(ckpt_dir)
+
+        degraded_seen = threading.Event()
+
+        def watch():
+            while not degraded_seen.is_set():
+                if srv.pool.degraded:
+                    degraded_seen.set()
+                time.sleep(0.01)
+
+        results, gens, errors = [], {}, []
+
+        def burst(i):
+            for _ in range(12):
+                try:
+                    r = c.predict({"x": np.full((2,), float(i),
+                                               np.float32)}, timeout=300)
+                    results.append((i, r["y"]))
+                except Exception as e:  # noqa: BLE001 - asserted below
+                    errors.append(e)
+
+        def gen_one(i):
+            try:
+                gens[i] = srv.generate(prompt, max_tokens=12, timeout=300)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=watch, daemon=True)]
+        threads += [threading.Thread(target=burst, args=(i,))
+                    for i in range(8)]
+        threads += [threading.Thread(target=gen_one, args=(i,))
+                    for i in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)  # let traffic land on both replicas
+        victim = sorted(srv.pool.replica_pids())[0]
+        os.kill(srv.pool.replica_pids()[victim], 9)
+        for t in threads[1:]:
+            t.join()
+
+        assert not errors, errors[:3]
+        assert len(results) == 96  # zero dropped predicts
+        for i, y in results:
+            assert y == pytest.approx([2.0 * i] * 2)
+        assert len(gens) == 3      # zero dropped decode sessions
+        for i, out in gens.items():
+            # zero-dup: re-prefilled orphans re-stream the exact oracle
+            assert out["tokens"] == oracle, (i, out["tokens"])
+
+        # re-grow: adopted, resharded back, full capacity — no reload
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            if (srv.pool.live_replicas() == [0, 1]
+                    and not srv.pool.degraded
+                    and srv.pool.adoptions >= 1):
+                break
+            time.sleep(0.2)
+        assert srv.pool.live_replicas() == [0, 1]
+        assert not srv.pool.degraded
+        assert srv.pool.adoptions >= 1
+        # formation + shrink + regrow, epoch-fenced
+        assert srv.pool.generation >= gen0 + 2
+        assert degraded_seen.wait(timeout=1), \
+            "the shrunk window was never declared degraded"
+        # the adopted incarnation serves the pool's version, with the
+        # checkpoint gone — cold reload would have left version 0
+        assert set(srv.pool.versions().values()) == {7}, srv.pool.versions()
+        after = c.predict({"x": np.full((2,), 9.0, np.float32)},
+                          timeout=300)
+        assert after["y"] == pytest.approx([18.0, 18.0])
+        assert srv.generate(prompt, max_tokens=12,
+                            timeout=300)["tokens"] == oracle
+        desc = srv.pool.describe()
+        assert desc["capacity"] == pytest.approx(1.0)
+        assert desc["last_resize_ms"] is None or desc["last_resize_ms"] >= 0
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_serve_chaos_smoke_keeps_serving(monkeypatch):
+    """Seeded chaos over the serving sites: faulted requests may error,
+    but the tier must keep serving afterwards (supervisor retries a
+    failed resize; a failed dispatch fails only that batch)."""
+    seed = int(os.environ.get("TFOS_CHAOS_SEED", "2024"))
+    plan = faults.random_plan(seed, sites=faults.SERVE_CHAOS_SITES)
+    print(f"chaos plan (seed {seed}): {plan}")
+    monkeypatch.setenv("TFOS_FAULT_PLAN", plan)
+    faults._reset_for_tests()
+    spec = R.ModelSpec(predict=_double_predict, params={}, jit=False)
+    try:
+        with S.Server(spec, num_replicas=2, max_batch=8, max_delay_ms=5,
+                      elastic=True) as srv:
+            c = srv.client()
+            errors = 0
+            for i in range(20):
+                try:
+                    out = c.predict({"x": np.full((2,), float(i),
+                                                  np.float32)}, timeout=120)
+                    assert out["y"] == pytest.approx([2.0 * i] * 2)
+                except Exception:  # noqa: BLE001 - injected
+                    errors += 1
+            # chaos plans carry at most 2 one-shot faults; the tier must
+            # absorb them and keep answering
+            assert errors <= 2
+            monkeypatch.delenv("TFOS_FAULT_PLAN")
+            faults._reset_for_tests()
+            out = c.predict({"x": np.ones(2, np.float32)}, timeout=120)
+            assert out["y"] == pytest.approx([2.0, 2.0])
+            assert srv.pool.live_replicas() == [0, 1]
+    finally:
+        monkeypatch.delenv("TFOS_FAULT_PLAN", raising=False)
+        faults._reset_for_tests()
