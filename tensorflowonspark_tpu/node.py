@@ -549,6 +549,8 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
             obs_id = f"{context.job_name}-{context.task_index}"
             pub = obs_publish.start_publisher(mgr, obs_id,
                                               role=context.job_name)
+            from tensorflowonspark_tpu.obs.health import HealthHalt
+
             try:
                 with telemetry.span("node/main", job=context.job_name,
                                     task=context.task_index):
@@ -558,6 +560,20 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
                 # all processes leave together (see sync_exit_barrier
                 # docstring)
                 context.sync_exit_barrier()
+            except HealthHalt as e:
+                # a health reaction (TFOS_HEALTH_ACTION=halt) already
+                # checkpointed at the last finite step; stop this node
+                # cleanly — no exit barrier (peers halting on the same
+                # anomaly stop on their own; waiting on a diverged run
+                # would burn exactly the chip hours halt exists to save)
+                logger.warning("node %s:%d health halt: %s",
+                               context.job_name, context.task_index, e)
+                telemetry.event("health/halt", job=context.job_name,
+                                task=context.task_index, reason=str(e))
+                try:
+                    mgr.set("state", "terminating")  # feeders drain
+                except Exception:  # noqa: BLE001 - manager tearing down
+                    pass
             finally:
                 hb.set()
                 if pub is not None:

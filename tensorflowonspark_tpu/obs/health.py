@@ -1,0 +1,404 @@
+"""Training-health watchtower: streaming anomaly detectors + reactions.
+
+No reference counterpart: the reference delegates run health entirely to
+TensorFlow's in-graph hooks (NaN guards, summary writers — SURVEY.md §5)
+and the rest of our obs stack is *forensic* (metrics, traces, flight
+dumps, SLO burn record what happened).  This module is the watching
+half: a :class:`HealthMonitor` rides the training loop's existing
+instrumentation (``utils.metrics.TrainMetrics`` feeds it step time,
+infeed stall fraction and the per-step loss; ``utils.train.health_probe``
+adds a device-computed global grad-norm behind ``TFOS_HEALTH_GRADNORM``)
+and edge-triggers four streaming detectors:
+
+- **NaN/Inf gate** — a non-finite loss (or grad norm) fires ``nan``;
+- **loss spike** — loss above the EWMA mean by ``TFOS_HEALTH_SPIKE_SIGMA``
+  EWMA standard deviations (after ``TFOS_HEALTH_WARMUP`` steps) fires
+  ``loss_spike``;
+- **step-time regression** — ``TFOS_HEALTH_STEP_PATIENCE`` consecutive
+  steps slower than ``TFOS_HEALTH_STEP_FACTOR`` x the EWMA baseline
+  fires ``slow_step``;
+- **infeed stall** — the window stall fraction crossing
+  ``TFOS_HEALTH_STALL_FRAC`` fires ``infeed_stall``.
+
+Every firing lands in all three observability planes at once: a
+``health/<kind>`` telemetry event, a flight-recorder snapshot
+(``obs/flight.py`` — the ring freezes while the anomaly is fresh), and
+the ``tfos_health_*`` registry metrics the obs publisher already ships
+(so ``/healthz`` flips to ``degraded`` and ``tfos-top --health`` shows
+the counts).  Edge-triggered means a detector fires on the transition
+into its anomalous state and re-arms when the signal recovers — a
+diverged run logs one event, not one per step.
+
+Reactions (``TFOS_HEALTH_ACTION=none|checkpoint|halt``, numeric kinds
+``nan`` only — spikes and stalls are advisory): ``checkpoint`` invokes
+the monitor's ``checkpoint_fn`` (the trainer wires it to save the last
+*finite* state), ``halt`` checkpoints then raises :class:`HealthHalt`,
+which ``node.wrapper_fn`` catches and turns into a clean stop — a NaN at
+step N costs one step of chip time, not the rest of the job.
+
+The driver-side half, :func:`straggler_report`, runs over the per-node
+``tfos_train_step_ms`` histograms the manager obs KV already carries
+(``obs/http.ObsServer`` polls them): cross-node p50 skew, the slow node
+named, exported as ``tfos_node_skew`` and a ``/statusz`` stragglers
+table — the signal ROADMAP item 1's replica autoscaling consumes.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+import threading
+import weakref
+
+from tensorflowonspark_tpu.utils import metrics_registry, telemetry
+
+logger = logging.getLogger(__name__)
+
+ENABLE_ENV = "TFOS_HEALTH"                  # "0" disables the detectors
+ACTION_ENV = "TFOS_HEALTH_ACTION"           # none | checkpoint | halt
+GRADNORM_ENV = "TFOS_HEALTH_GRADNORM"       # device-side probe gate
+SPIKE_SIGMA_ENV = "TFOS_HEALTH_SPIKE_SIGMA"
+WARMUP_ENV = "TFOS_HEALTH_WARMUP"
+STEP_FACTOR_ENV = "TFOS_HEALTH_STEP_FACTOR"
+STEP_PATIENCE_ENV = "TFOS_HEALTH_STEP_PATIENCE"
+STALL_FRAC_ENV = "TFOS_HEALTH_STALL_FRAC"
+
+ACTIONS = ("none", "checkpoint", "halt")
+
+#: Detector kinds a monitor can fire (the ``kind`` label of
+#: ``tfos_health_anomalies_total`` and the suffix of ``health/<kind>``).
+KINDS = ("nan", "loss_spike", "slow_step", "infeed_stall")
+
+#: Kinds the configured reaction applies to: only numeric corruption is
+#: worth stopping a run for — spikes and stalls are advisory signals.
+REACT_KINDS = ("nan",)
+
+_EWMA_ALPHA = 0.05  # ~20-step memory for the loss/step-time baselines
+
+
+def enabled():
+    """Detectors on unless ``TFOS_HEALTH=0`` (they are pure python and
+    cost a few comparisons per step)."""
+    return os.environ.get(ENABLE_ENV, "1").strip().lower() not in (
+        "0", "false", "off", "no")
+
+
+def action_from_env():
+    """The configured reaction; an unknown value warns and means none
+    (a typo'd reaction must not silently halt — or silently not)."""
+    raw = os.environ.get(ACTION_ENV, "none").strip().lower() or "none"
+    if raw not in ACTIONS:
+        logger.warning("%s=%r not in %s; treating as 'none'",
+                       ACTION_ENV, raw, ACTIONS)
+        return "none"
+    return raw
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        logger.warning("%s is not a number; using %s", name, default)
+        return float(default)
+
+
+class HealthHalt(RuntimeError):
+    """Raised by a monitor whose reaction is ``halt``; ``node.wrapper_fn``
+    converts it into a clean run stop (checkpoint already written)."""
+
+
+# Monitors constructed in this process, for bench.py's summary block and
+# debugging; weak so short-lived trainers don't accumulate.
+_MONITORS = weakref.WeakSet()
+_MONITORS_LOCK = threading.Lock()
+
+# Last straggler report computed in this process (driver side), folded
+# into bench.py's health summary as max_skew.
+_LAST_STRAGGLERS = {}
+
+
+class HealthMonitor:
+    """Streaming detectors over one trainer's step stream.
+
+    Feed it from the loop via ``observe_step`` (``TrainMetrics.step``
+    does this automatically when constructed with a monitor or when the
+    detectors are enabled); every argument is optional — a detector
+    without its signal simply stays quiet.
+    """
+
+    def __init__(self, action=None, checkpoint_fn=None, node=None):
+        self.action = action_from_env() if action is None else str(action)
+        if self.action not in ACTIONS:
+            raise ValueError(f"action {self.action!r} not in {ACTIONS}")
+        self.checkpoint_fn = checkpoint_fn
+        self.node = node
+        self.spike_sigma = _env_float(SPIKE_SIGMA_ENV, 6.0)
+        self.warmup = int(_env_float(WARMUP_ENV, 20))
+        self.step_factor = _env_float(STEP_FACTOR_ENV, 2.0)
+        self.step_patience = int(_env_float(STEP_PATIENCE_ENV, 5))
+        self.stall_frac = _env_float(STALL_FRAC_ENV, 0.5)
+        # detector state
+        self._loss_mean = None   # EWMA of loss
+        self._loss_var = 0.0     # EWMA of squared deviation
+        self._loss_seen = 0
+        self._time_mean = None   # EWMA of step seconds
+        self._time_seen = 0
+        self._slow_run = 0       # consecutive slow steps
+        self._in_anomaly = {}    # kind -> currently anomalous (edge state)
+        self.counts = {}         # kind -> total firings
+        self.last_anomaly = None  # dict describing the newest firing
+        self.last_finite_step = None  # newest step with a finite loss
+        with _MONITORS_LOCK:
+            _MONITORS.add(self)
+
+    # -- observation ---------------------------------------------------
+
+    def observe_step(self, loss=None, step_time_s=None, infeed_frac=None,
+                     grad_norm=None, grad_finite=None, step=None):
+        """One completed train step's signals; returns the list of
+        anomaly kinds that fired (edge transitions only).
+
+        ``loss``/``grad_norm`` must already be host floats — the caller
+        decides when to pay the device sync (``TrainMetrics`` fetches
+        the loss it is handed; the grad probe is one scalar)."""
+        fired = []
+        fired += self._observe_finite(loss, grad_norm, grad_finite, step)
+        if loss is not None and math.isfinite(float(loss)):
+            fired += self._observe_spike(float(loss), step)
+        if grad_norm is not None and math.isfinite(float(grad_norm)):
+            metrics_registry.set_gauge("tfos_health_grad_norm",
+                                       float(grad_norm))
+        if step_time_s is not None:
+            fired += self._observe_step_time(float(step_time_s), step)
+        if infeed_frac is not None:
+            fired += self._observe_stall(float(infeed_frac), step)
+        return fired
+
+    def _observe_finite(self, loss, grad_norm, grad_finite, step):
+        bad = []
+        if loss is not None and not math.isfinite(float(loss)):
+            bad.append(("loss", float(loss)))
+        if grad_norm is not None and not math.isfinite(float(grad_norm)):
+            bad.append(("grad_norm", float(grad_norm)))
+        if grad_finite is not None and not bool(grad_finite):
+            bad.append(("grad_finite", 0.0))
+        if not bad:
+            if loss is not None and step is not None:
+                self.last_finite_step = step
+            self._in_anomaly["nan"] = False
+            return []
+        source, value = bad[0]
+        return self._fire("nan", step, source=source, value=str(value),
+                          last_finite_step=self.last_finite_step)
+
+    def _observe_spike(self, loss, step):
+        mean, var, seen = self._loss_mean, self._loss_var, self._loss_seen
+        fired = []
+        if seen >= self.warmup and mean is not None:
+            sigma = math.sqrt(max(var, 0.0))
+            floor = 1e-3 * max(abs(mean), 1.0)  # dead-flat loss guard
+            threshold = mean + self.spike_sigma * max(sigma, floor)
+            if loss > threshold:
+                fired = self._fire("loss_spike", step, loss=round(loss, 6),
+                                   mean=round(mean, 6),
+                                   threshold=round(threshold, 6))
+            else:
+                self._in_anomaly["loss_spike"] = False
+        # update the baseline AFTER the test (a spike must not drag the
+        # mean up before it is judged); spikes still enter the EWMA so a
+        # genuine regime change re-arms within ~1/alpha steps
+        if mean is None:
+            self._loss_mean, self._loss_var = loss, 0.0
+        else:
+            d = loss - mean
+            self._loss_mean = mean + _EWMA_ALPHA * d
+            self._loss_var = (1 - _EWMA_ALPHA) * (var + _EWMA_ALPHA * d * d)
+        self._loss_seen = seen + 1
+        return fired
+
+    def _observe_step_time(self, dur_s, step):
+        mean, seen = self._time_mean, self._time_seen
+        fired = []
+        if seen >= self.warmup and mean is not None and mean > 0:
+            if dur_s > self.step_factor * mean:
+                self._slow_run += 1
+                if self._slow_run >= self.step_patience:
+                    fired = self._fire(
+                        "slow_step", step,
+                        step_ms=round(dur_s * 1000.0, 3),
+                        baseline_ms=round(mean * 1000.0, 3),
+                        consecutive=self._slow_run)
+            else:
+                self._slow_run = 0
+                self._in_anomaly["slow_step"] = False
+            # slow steps are excluded from the baseline while the run is
+            # anomalous — a stuck-slow node must keep comparing against
+            # its healthy self, not converge to the regression
+            if self._slow_run:
+                return fired
+        if mean is None:
+            self._time_mean = dur_s
+        else:
+            self._time_mean = mean + _EWMA_ALPHA * (dur_s - mean)
+        self._time_seen = seen + 1
+        return fired
+
+    def _observe_stall(self, frac, step):
+        if self._loss_seen + self._time_seen < self.warmup:
+            return []
+        if frac >= self.stall_frac:
+            return self._fire("infeed_stall", step,
+                              stall_frac=round(frac, 4),
+                              threshold=self.stall_frac)
+        self._in_anomaly["infeed_stall"] = False
+        return []
+
+    # -- firing + reactions --------------------------------------------
+
+    def _fire(self, kind, step, **attrs):
+        if not self._in_anomaly.get(kind):
+            self._in_anomaly[kind] = True
+            self.counts[kind] = self.counts.get(kind, 0) + 1
+            self.last_anomaly = dict(kind=kind, step=step, **attrs)
+            logger.warning("health: %s anomaly at step %s (%s)",
+                           kind, step, attrs)
+            telemetry.event(f"health/{kind}", step=step,
+                            action=self.action, **attrs)
+            metrics_registry.inc("tfos_health_anomalies_total", kind=kind)
+            metrics_registry.set_gauge("tfos_health_status", 1.0)
+            if step is not None:
+                metrics_registry.set_gauge("tfos_health_last_anomaly_step",
+                                           float(step))
+            # freeze the flight ring while the last N seconds still show
+            # the approach to the anomaly (ISSUE 16 satellite: health/*
+            # joins the supervision events as a dump trigger)
+            try:
+                from tensorflowonspark_tpu.obs import flight as _flight
+
+                _flight.snapshot(f"health/{kind}", node=self.node,
+                                 reason=f"{kind} at step {step}")
+            except Exception:  # noqa: BLE001 - dumps are best-effort
+                logger.debug("flight snapshot failed", exc_info=True)
+            self._react(kind, step)
+            return [kind]
+        return []
+
+    def _react(self, kind, step):
+        if self.action == "none" or kind not in REACT_KINDS:
+            return
+        if self.checkpoint_fn is not None:
+            try:
+                self.checkpoint_fn()
+                logger.warning(
+                    "health: checkpointed at last finite step %s "
+                    "(action=%s)", self.last_finite_step, self.action)
+            except Exception:  # noqa: BLE001 - still halt if asked
+                logger.exception("health: reaction checkpoint failed")
+        if self.action == "halt":
+            telemetry.flush()  # the event must survive the stop
+            raise HealthHalt(
+                f"health: {kind} at step {step} (action=halt; "
+                f"last finite step {self.last_finite_step})")
+
+    # -- reading -------------------------------------------------------
+
+    @property
+    def status(self):
+        return "degraded" if any(self._in_anomaly.values()) else "ok"
+
+    def summary(self):
+        return {"anomalies": dict(self.counts),
+                "total": sum(self.counts.values()),
+                "status": self.status,
+                "last": self.last_anomaly}
+
+
+def monitor_from_env(checkpoint_fn=None, node=None):
+    """The zero-config constructor ``TrainMetrics`` uses: a monitor when
+    the detectors are enabled, else None (every observe call skipped)."""
+    if not enabled():
+        return None
+    return HealthMonitor(checkpoint_fn=checkpoint_fn, node=node)
+
+
+def process_summary():
+    """Aggregate health over every monitor this process created plus the
+    last straggler report — bench.py's ``health`` block."""
+    anomalies = {}
+    total = 0
+    status = "ok"
+    with _MONITORS_LOCK:
+        monitors = list(_MONITORS)
+    for m in monitors:
+        for kind, n in m.counts.items():
+            anomalies[kind] = anomalies.get(kind, 0) + n
+            total += n
+        if m.status == "degraded":
+            status = "degraded"
+    out = {"anomalies": anomalies, "total": total, "status": status,
+           "max_skew": _LAST_STRAGGLERS.get("skew")}
+    if _LAST_STRAGGLERS.get("slowest"):
+        out["slowest_node"] = _LAST_STRAGGLERS["slowest"]
+    return out
+
+
+# -- driver-side straggler analysis ------------------------------------
+
+
+def _step_hist(snap, metric="tfos_train_step_ms"):
+    ent = (snap or {}).get(metric)
+    for s in (ent or {}).get("series", ()):
+        if "count" in s:
+            return s
+    return None
+
+
+def straggler_report(node_entries, min_nodes=2, min_count=2,
+                     emit=True):
+    """Cross-node step-time skew from ``ObsServer`` node entries.
+
+    ``node_entries`` is ``{node_id: {"metrics": snapshot, ...}}`` (the
+    shape ``ObsServer._node_entries`` returns).  Nodes publishing a
+    ``tfos_train_step_ms`` histogram with at least ``min_count`` samples
+    enter the comparison; with fewer than ``min_nodes`` of them there is
+    no cross-node statement to make and the report is None.
+
+    Returns ``{"skew", "slowest", "fastest", "nodes": [{node, p50_ms,
+    steps, rel}...]}`` where ``skew`` = slowest p50 / fastest p50 and
+    ``rel`` is each node's p50 relative to the fastest.  ``emit=True``
+    also sets the driver-registry ``tfos_node_skew`` gauge and caches
+    the result for :func:`process_summary`."""
+    rows = []
+    for nid, ent in sorted((node_entries or {}).items()):
+        h = _step_hist(ent.get("metrics"))
+        if not h or h.get("count", 0) < min_count:
+            continue
+        p50 = metrics_registry.quantile(h, 0.5)
+        if p50 is None or p50 <= 0:
+            continue
+        rows.append({"node": nid, "p50_ms": round(float(p50), 3),
+                     "steps": int(h["count"])})
+    if len(rows) < min_nodes:
+        return None
+    fastest = min(rows, key=lambda r: r["p50_ms"])
+    slowest = max(rows, key=lambda r: r["p50_ms"])
+    for r in rows:
+        r["rel"] = round(r["p50_ms"] / fastest["p50_ms"], 3)
+    skew = round(slowest["p50_ms"] / fastest["p50_ms"], 3)
+    report = {"skew": skew, "slowest": slowest["node"],
+              "fastest": fastest["node"], "nodes": rows}
+    if emit:
+        metrics_registry.set_gauge("tfos_node_skew", skew)
+        _LAST_STRAGGLERS.clear()
+        _LAST_STRAGGLERS.update(skew=skew, slowest=slowest["node"])
+    return report
+
+
+def snapshot_anomaly_total(snap):
+    """Total ``tfos_health_anomalies_total`` across kinds in one registry
+    snapshot (the ``/healthz`` degraded test), or None when unreported."""
+    ent = (snap or {}).get("tfos_health_anomalies_total")
+    if not ent:
+        return None
+    return sum(s.get("value", 0.0) for s in ent.get("series", ()))

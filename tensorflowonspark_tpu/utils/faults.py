@@ -14,7 +14,7 @@ Plan grammar (``TFOS_FAULT_PLAN``)::
 
     plan  := entry ("," entry)*
     entry := site ":" kind ["(" arg ")"] ["@" hits]
-    kind  := "exc" | "kill" | "hang" | "delay"
+    kind  := "exc" | "kill" | "hang" | "delay" | "nan"
     hits  := N      -- fire on exactly the N-th check of this site (1-based)
            | N "+"  -- fire on the N-th and every later check
            | "*"    -- fire on every check
@@ -28,6 +28,13 @@ Plan grammar (``TFOS_FAULT_PLAN``)::
                    a wedged node that only heartbeat staleness can detect
 - ``delay(secs)``  sleep briefly (default 1s) then continue; models slow,
                    not dead
+- ``nan``          value poison: :func:`poison` returns NaN in place of the
+                   value it was handed (a silent numeric corruption, the
+                   diverged-training case).  Only :func:`poison` call sites
+                   honor it — :func:`check` ignores ``nan`` entries, and the
+                   two keep separate hit counters, so ``train.step:nan@5``
+                   poisons exactly the 5th step regardless of how many
+                   ``check`` kinds share the site.
 
 Hit counters are **per process, per site**: a respawned executor or a
 relaunched trainer starts from zero, which is exactly the semantics a
@@ -56,13 +63,14 @@ logger = logging.getLogger(__name__)
 PLAN_ENV = "TFOS_FAULT_PLAN"
 EXECUTOR_ENV = "TFOS_FAULT_EXECUTOR"
 
-KINDS = ("exc", "kill", "hang", "delay")
+KINDS = ("exc", "kill", "hang", "delay", "nan")
 
 #: Injection points wired into the runtime (site -> where it fires).
 SITES = (
     "engine.task",          # engine.py executor loop, before running a task
     "node.boot",            # node.py _mapfn, before the manager starts
     "node.main",            # node.py wrapper_fn, before user main_fun
+    "train.step",           # utils/metrics.py TrainMetrics.step, per step
     "feed.put",             # node.py feeder, before each chunk put
     "feed.get",             # feed.py DataFeed, after each chunk pop
     "data.serve",           # data/service.py worker, before each unit
@@ -206,7 +214,9 @@ def check(site, **attrs):
     faults = _faults_for_this_process()
     if not faults:
         return
-    armed = [f for f in faults if f.site == site]
+    # nan entries are value poison, consumed by poison() with its own
+    # counter — a check at the same site must neither fire nor count them
+    armed = [f for f in faults if f.site == site and f.kind != "nan"]
     if not armed or _scoped_out():
         return
     hit = _state["hits"].get(site, 0) + 1
@@ -244,6 +254,36 @@ def check(site, **attrs):
         if f.kind == "delay":
             time.sleep(1.0 if f.arg is None else f.arg)
         return
+
+
+def poison(site, value):
+    """Value-poison injection point: return ``value``, or ``float('nan')``
+    when a planned ``nan`` fault fires on this hit.
+
+    The counterpart of :func:`check` for corruptions that travel *through*
+    a value instead of control flow — the health watchtower's NaN-gate
+    e2e seeds ``train.step:nan@N`` and the N-th recorded loss goes NaN
+    deterministically.  Hits are counted per process per site under a
+    separate ``nan`` counter (see the module docstring), and the firing
+    leaves the same ``fault/injected`` event as every other kind."""
+    faults = _faults_for_this_process()
+    if not faults:
+        return value
+    armed = [f for f in faults if f.site == site and f.kind == "nan"]
+    if not armed or _scoped_out():
+        return value
+    key = site + "#nan"
+    hit = _state["hits"].get(key, 0) + 1
+    _state["hits"][key] = hit
+    for f in armed:
+        if not f.matches(hit):
+            continue
+        logger.warning("fault injection: %r poisoning hit %d of %s (pid %d)",
+                       f, hit, site, os.getpid())
+        telemetry.event("fault/injected", site=site, kind="nan", hit=hit,
+                        pid=os.getpid())
+        return float("nan")
+    return value
 
 
 def random_plan(seed, max_faults=2, sites=CHAOS_SITES):

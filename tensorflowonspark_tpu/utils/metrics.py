@@ -20,7 +20,7 @@ import logging
 import os
 import time
 
-from tensorflowonspark_tpu.utils import metrics_registry, telemetry
+from tensorflowonspark_tpu.utils import faults, metrics_registry, telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -109,12 +109,28 @@ def mnist_inference_flops_per_row():
 
 
 class TrainMetrics:
-    """Windowed counters; cheap enough for the hot loop."""
+    """Windowed counters; cheap enough for the hot loop.
 
-    def __init__(self, flops_per_item=None, device=None, window=50):
+    Also the feed point of the training-health watchtower
+    (``obs/health.py``): by default a :class:`~.health.HealthMonitor`
+    rides along (``TFOS_HEALTH=0`` disables it; pass ``health=False`` to
+    opt one instance out, or your own monitor to wire a ``checkpoint_fn``
+    for the ``TFOS_HEALTH_ACTION`` reactions) and every ``step()`` hands
+    it the step duration, the infeed-stall fraction, and — when the
+    caller supplies them — the loss and the device-computed grad-norm
+    probe (``utils.train.health_probe``)."""
+
+    def __init__(self, flops_per_item=None, device=None, window=50,
+                 health=None):
         self.flops_per_item = flops_per_item
         self.window = window
         self._peak = peak_flops(device) if flops_per_item else None
+        if health is None:
+            from tensorflowonspark_tpu.obs import health as _health
+
+            self.health = _health.monitor_from_env()
+        else:
+            self.health = health or None  # health=False opts out
         self.reset()
 
     def reset(self):
@@ -129,12 +145,28 @@ class TrainMetrics:
     def infeed_wait(self, seconds):
         self.infeed_time += seconds
 
-    def step(self, items=0):
+    def step(self, items=0, loss=None, grad_norm=None, grad_finite=None):
         """Call once per completed train step with the item count.
 
         The first call only arms the timer; its items are NOT counted, so
-        rates divide N timed steps' items by N timed steps' time."""
+        rates divide N timed steps' items by N timed steps' time.
+
+        ``loss`` (optional) feeds the health monitor's NaN gate and
+        loss-spike detector — pass the step's scalar loss (the float()
+        here is the same value fetch the timing convention already
+        requires, PERF.md r4).  ``grad_norm``/``grad_finite`` forward
+        the ``utils.train.health_probe`` outputs.  A configured
+        ``TFOS_HEALTH_ACTION=halt`` propagates :class:`HealthHalt` out
+        of this call on a numeric anomaly."""
+        # injection point: ``train.step`` — check() serves delay/exc
+        # (seeded stragglers), poison() the deterministic NaN e2e.  Both
+        # sit before the clock read so an injected delay lands in this
+        # step's measured duration like a real slowdown would.
+        faults.check("train.step")
+        if loss is not None:
+            loss = faults.poison("train.step", loss)
         now = time.perf_counter()
+        dur = None
         if self._last is not None:
             dur = now - self._last
             self.step_time += dur
@@ -167,6 +199,16 @@ class TrainMetrics:
                             / self.step_time / self._peak)
         self._last = now
         self.steps += 1
+        if self.health is not None:
+            self.health.observe_step(
+                loss=None if loss is None else float(loss),
+                step_time_s=dur,
+                infeed_frac=(min(self.infeed_time / self.step_time, 1.0)
+                             if self.step_time else None),
+                grad_norm=(None if grad_norm is None else float(grad_norm)),
+                grad_finite=(None if grad_finite is None
+                             else bool(grad_finite)),
+                step=self.steps)
 
     # -- reading ------------------------------------------------------------
 

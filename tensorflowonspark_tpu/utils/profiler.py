@@ -88,27 +88,63 @@ def stop_tensorboard(proc):
         proc.wait(timeout=10)
 
 
-def start_trace(log_dir):
-    """Begin an XLA device trace (viewable in TensorBoard's profile tab)."""
-    import jax
+# Capture degrades to a no-op on images where jax.profiler can't start a
+# trace (no jax, no profiler plugin, CPU-only builds without the capture
+# backend).  A missing profiler must never take down the run — or the
+# obs control plane asking a worker for an on-demand capture — so every
+# entry point warns once and reports success as a boolean.
+_degraded_warned = False
 
-    jax.profiler.start_trace(log_dir)
+
+def _warn_unavailable(err):
+    global _degraded_warned
+    if not _degraded_warned:
+        logger.warning(
+            "jax profiler capture unavailable (%s); trace is a no-op", err)
+        _degraded_warned = True
+    else:
+        logger.debug("jax profiler capture unavailable: %s", err)
+
+
+def start_trace(log_dir):
+    """Begin an XLA device trace (viewable in TensorBoard's profile tab).
+
+    Returns True when a capture actually started; False when capture is
+    unavailable in this build (warned once, never raises)."""
+    try:
+        import jax
+
+        jax.profiler.start_trace(log_dir)
+        return True
+    except Exception as e:  # noqa: BLE001 - capture is best-effort
+        _warn_unavailable(e)
+        return False
 
 
 def stop_trace():
-    import jax
+    """End the running trace; returns True on success (never raises)."""
+    try:
+        import jax
 
-    jax.profiler.stop_trace()
+        jax.profiler.stop_trace()
+        return True
+    except Exception as e:  # noqa: BLE001 - capture is best-effort
+        _warn_unavailable(e)
+        return False
 
 
 @contextlib.contextmanager
 def trace(log_dir, enabled=True):
-    """``with profiler.trace(log_dir): step(...)`` around hot steps."""
+    """``with profiler.trace(log_dir): step(...)`` around hot steps.
+
+    Degrades to a plain passthrough when capture is unavailable (the
+    body always runs; only the trace is skipped)."""
     if not enabled:
         yield
         return
-    start_trace(log_dir)
+    started = start_trace(log_dir)
     try:
         yield
     finally:
-        stop_trace()
+        if started:
+            stop_trace()

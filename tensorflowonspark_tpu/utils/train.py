@@ -10,9 +10,50 @@ memory in the number of microbatches).
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+GRADNORM_ENV = "TFOS_HEALTH_GRADNORM"
+
+
+def gradnorm_enabled():
+    """True when ``TFOS_HEALTH_GRADNORM`` asks for the device-side health
+    probe.  Read at trace time: the fold into the jitted step happens (or
+    not) when the step is built, so the off path costs literally zero."""
+    return os.environ.get(GRADNORM_ENV, "").strip().lower() in (
+        "1", "true", "on", "yes")
+
+
+def global_norm(tree):
+    """Global L2 norm over a gradient pytree, accumulated in float32 —
+    one scalar, cheap next to the backward pass that produced the tree."""
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    sq = sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves)
+    return jnp.sqrt(sq)
+
+
+def health_probe(loss, grads):
+    """Device-computed health scalars for the watchtower (obs/health.py),
+    folded into the train step behind ``TFOS_HEALTH_GRADNORM``.
+
+    Returns ``{"grad_norm", "finite"}`` (a float32 scalar and a bool
+    scalar: ``isfinite(loss) & isfinite(grad_norm)``) to return alongside
+    the step outputs and forward into ``TrainMetrics.step(grad_norm=...,
+    grad_finite=...)`` — or None when the gate is off, so callers can
+    write ``probe = train.health_probe(loss, grads)`` unconditionally
+    inside the jitted step and pay nothing unless enabled."""
+    if not gradnorm_enabled():
+        return None
+    gn = global_norm(grads)
+    finite = jnp.logical_and(
+        jnp.all(jnp.isfinite(jnp.asarray(loss, jnp.float32))),
+        jnp.isfinite(gn))
+    return {"grad_norm": gn, "finite": finite}
 
 
 def split_microbatches(batch, accum_steps):
