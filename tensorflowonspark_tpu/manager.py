@@ -45,6 +45,12 @@ HEARTBEAT_KEY = "heartbeat"
 # obs/http.py polls); the suffix is the publishing process's node id.
 OBS_KEY = "obs:"
 
+# KV key prefixes for the on-demand obs control plane (driver writes a
+# directive under CTL, the node's publish daemon consumes it and writes
+# the result under ACK; obs/http.py /profilez and /flightz round-trip).
+CTL_KEY = "obsctl:"
+ACK_KEY = "obsack:"
+
 
 def heartbeat_interval():
     """Beat cadence (seconds).  ``TFOS_ACTOR_HEARTBEAT_SECS`` is the
@@ -161,6 +167,26 @@ class TFManager(BaseManager):
     def obs_snapshots(self):
         return {str(k)[len(OBS_KEY):]: v for k, v in self.kv().items()
                 if str(k).startswith(OBS_KEY)}
+
+    # -- obs control plane (obs/http.py -> obs/publish.py) -------------
+    # One directive slot and one ack slot per node id: the driver posts
+    # {"cmd", "seq", ...}, the node's publish daemon pop()s it (atomic
+    # on the DictProxy — consumed exactly once even with a respawned
+    # daemon racing), executes, and acks with the same seq so the driver
+    # can tell a fresh result from a stale one.  id-unique keys, no
+    # read-modify-write — same discipline as the channels above.
+
+    def obs_control_post(self, node_id, directive):
+        self.kv().update({CTL_KEY + str(node_id): directive})
+
+    def obs_control_take(self, node_id):
+        return self.kv().pop(CTL_KEY + str(node_id), None)
+
+    def obs_control_ack(self, node_id, result):
+        self.kv().update({ACK_KEY + str(node_id): result})
+
+    def obs_control_result(self, node_id):
+        return self.kv().get(ACK_KEY + str(node_id))
 
 
 # Server-side singletons (one manager process per executor).  Queues are

@@ -13,15 +13,29 @@ driver's own registry, and serves:
   503 otherwise (load-balancer semantics).
 - ``/statusz``  JSON cluster snapshot: epoch, restart budget/used,
   feed-ledger progress, a per-node summary (last-seen, step rate,
-  queue depth, stall %, SLO percentiles) and the SLO engine's last
+  queue depth, stall %, SLO percentiles), the straggler table
+  (``obs/health.py`` skew analysis) and the SLO engine's last
   report — what ``tfos-top`` renders.
 - ``/slo``      JSON burn-rate report, re-evaluated per request
   (``obs/slo.py``): objective, current value, burn, breaching.
+- ``POST /profilez?node=&ms=``  on-demand profiling control plane:
+  writes a capture directive into the named node's manager KV, waits
+  for its publish daemon to run ``utils.profiler.trace`` for the
+  window, and returns the spooled-back capture path (202 when the ack
+  hasn't landed inside the wait window — poll again with the same
+  node).  ``POST /flightz?node=`` does the same for an on-demand
+  flight-recorder dump.
+
+``/healthz`` additionally reports ``degraded`` (still 503 — don't route
+work at a sick cluster) when any node's published metrics carry health
+anomalies (``obs/health.py`` detectors), even while every heartbeat is
+live.
 
 Gated on ``TFOS_OBS_PORT`` (no server, no threads, no polling when
 unset); port 0 binds an ephemeral port, exposed as ``server.port``.
 Transport/auth note: binds loopback by default (``TFOS_OBS_HOST`` to
-widen); the endpoint is read-only.
+widen); GETs are read-only, the POST control verbs only trigger
+capture-to-disk on the target node (nothing is mutated in the run).
 """
 
 from __future__ import annotations
@@ -31,9 +45,11 @@ import logging
 import socket as _socket
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from tensorflowonspark_tpu import manager as tfmanager
+from tensorflowonspark_tpu.obs import health as _health
 from tensorflowonspark_tpu.obs import slo as _slo
 from tensorflowonspark_tpu.utils import metrics_registry
 
@@ -110,6 +126,18 @@ def node_summary(snap):
         if rh:
             out["resize_p99_s"] = _round(
                 metrics_registry.quantile(rh, 0.99), 4)
+    ha = _metric_total(snap, "tfos_health_anomalies_total")
+    if ha:
+        out["health_anomalies"] = ha
+    hs = _metric_gauge(snap, "tfos_health_status")
+    if hs is not None:
+        out["health"] = "degraded" if hs else "ok"
+    gn = _metric_gauge(snap, "tfos_health_grad_norm")
+    if gn is not None:
+        out["grad_norm"] = _round(gn, 4)
+    skew = _metric_gauge(snap, "tfos_node_skew")
+    if skew is not None:
+        out["node_skew"] = _round(skew, 3)
     dh = _metric_hist(snap, "tfos_decode_ttft_ms")
     if dh:
         out["decode_ttft_p99_ms"] = _round(
@@ -151,6 +179,7 @@ class ObsServer:
         self._mgrs = {}    # (host, executor_id) -> manager proxy
         self._httpd = None
         self._threads = []
+        self._ctl_seq = 0  # control-directive sequence (under _lock)
         self.slo = _slo.Engine()
 
     # -- lifecycle -----------------------------------------------------
@@ -168,8 +197,8 @@ class ObsServer:
                              name="tfos-obs-poll", daemon=True)
         p.start()
         self._threads.append(p)
-        logger.info("obs: serving /metrics /healthz /statusz /slo on %s",
-                    self.url)
+        logger.info("obs: serving /metrics /healthz /statusz /slo "
+                    "(+POST /profilez /flightz) on %s", self.url)
         return self
 
     @property
@@ -254,8 +283,10 @@ class ObsServer:
 
     def poll_once(self):
         """One sweep over the cluster's nodes, then one SLO evaluation
-        over everything the sweep (plus the driver registry) can see
-        (the poll thread's body; callable directly in tests)."""
+        over everything the sweep (plus the driver registry) can see,
+        then one straggler analysis over the per-node step-time
+        histograms (the poll thread's body; callable directly in
+        tests)."""
         cluster = self.cluster
         if cluster is not None:
             for meta in list(getattr(cluster, "cluster_info", ()) or ()):
@@ -263,6 +294,10 @@ class ObsServer:
                     return
                 self._poll_node(meta)
         self.slo.step(self._all_snapshots())
+        # emits the tfos_node_skew gauge into the driver registry (and
+        # the process_summary cache bench.py reads); /statusz recomputes
+        # per request so a probe never sees a stale table
+        _health.straggler_report(self._node_entries())
 
     def _all_snapshots(self):
         """Every registry snapshot in view: the driver's own plus each
@@ -280,6 +315,75 @@ class ObsServer:
             except Exception as e:  # noqa: BLE001 - keep serving
                 logger.debug("obs poll error: %s", e)
             self._stop.wait(self.interval)
+
+    # -- on-demand control plane ---------------------------------------
+
+    def _meta_for_node(self, node_id):
+        """The cluster_info meta whose manager can reach ``node_id``:
+        the node's own executor for cluster nodes, else the executor
+        that last published under that id (data workers, feeders)."""
+        metas = list(getattr(self.cluster, "cluster_info", ()) or ())
+        for meta in metas:
+            if f"{meta['job_name']}-{meta['task_index']}" == str(node_id):
+                return meta
+        ent = self._node_entries().get(str(node_id))
+        if ent is not None and ent.get("executor_id") is not None:
+            for meta in metas:
+                if meta["executor_id"] == ent["executor_id"]:
+                    return meta
+        return None
+
+    def request_control(self, node_id, directive, wait_s=None):
+        """Round-trip one control directive to a node: post it under the
+        node's ``obsctl:`` KV slot, then poll the ``obsack:`` slot until
+        the node's publish daemon acks with the same sequence number.
+
+        Returns the ack dict plus a ``code`` hint for the HTTP layer:
+        200 on a completed round-trip (``ok`` False inside means the
+        node executed but degraded, e.g. no profiler backend), 202 when
+        the window expired with the directive still posted (slow node;
+        it will still execute and a later request sees the ack), 404/502
+        for unknown node / unreachable manager."""
+        node_id = str(node_id)
+        meta = self._meta_for_node(node_id)
+        if meta is None:
+            return {"ok": False, "code": 404, "node": node_id,
+                    "error": f"unknown node {node_id!r}"}
+        mgr = self._manager_for(meta)
+        if mgr is None:
+            return {"ok": False, "code": 502, "node": node_id,
+                    "error": "node manager unreachable"}
+        with self._lock:
+            self._ctl_seq += 1
+            seq = self._ctl_seq
+        directive = dict(directive, seq=seq, ts=time.time())
+        try:
+            mgr.obs_control_post(node_id, directive)
+        except Exception as e:  # noqa: BLE001 - manager died mid-post
+            self._mgrs.pop((meta["host"], meta["executor_id"]), None)
+            return {"ok": False, "code": 502, "node": node_id,
+                    "error": f"directive post failed: {e}"}
+        if wait_s is None:
+            # directives are served once per publish tick; two ticks plus
+            # the capture window bounds a healthy round trip — floored at
+            # 15s because a profile's first capture cold-imports jax in
+            # the publish daemon (measured ~4-5s on CPU, worse on TPU)
+            wait_s = min(max(2.0 * self.interval + 3.0
+                             + float(directive.get("ms") or 0) / 1000.0,
+                             15.0), 75.0)
+        deadline = time.time() + max(float(wait_s), 0.0)
+        while time.time() < deadline and not self._stop.is_set():
+            try:
+                ack = mgr.obs_control_result(node_id)
+            except Exception:  # noqa: BLE001 - retry until deadline
+                ack = None
+            if isinstance(ack, dict) and ack.get("seq") == seq:
+                return dict(ack, code=200)
+            time.sleep(min(0.05, self.interval))
+        return {"ok": None, "code": 202, "node": node_id, "seq": seq,
+                "accepted": True,
+                "error": f"no ack within {wait_s:.1f}s (directive still "
+                         f"queued; the node serves it on its next tick)"}
 
     # -- endpoint bodies -----------------------------------------------
 
@@ -302,6 +406,7 @@ class ObsServer:
         now = time.time()
         nodes = {}
         healthy = True
+        degraded = False
         for nid, ent in sorted(self._node_entries().items()):
             hb = ent.get("heartbeat_age_s")
             seen = ent.get("last_seen")
@@ -313,8 +418,18 @@ class ObsServer:
                 "heartbeat_age_s": _round(hb),
                 "publish_age_s": _round(now - seen) if seen else None,
             }
-        return {"status": "ok" if healthy else "unhealthy",
-                "nodes": nodes}
+            anomalies = _health.snapshot_anomaly_total(ent.get("metrics"))
+            if anomalies:
+                degraded = True
+                nodes[nid]["anomalies"] = anomalies
+        # the driver's own registry too: an in-process monitor (bench,
+        # driver-side trainer) degrades /healthz without a publish hop
+        own = _health.snapshot_anomaly_total(metrics_registry.snapshot())
+        if own:
+            degraded = True
+        status = ("unhealthy" if not healthy
+                  else "degraded" if degraded else "ok")
+        return {"status": status, "nodes": nodes}
 
     def render_statusz(self):
         cluster = self.cluster
@@ -354,6 +469,12 @@ class ObsServer:
                 "summary": node_summary(driver),
             }
         out["nodes"] = nodes
+        # cross-node step-time skew: who is slow, and by how much
+        # (obs/health.py; recomputed per request, emit only on the poll
+        # thread so request traffic never mutates the driver registry)
+        strag = _health.straggler_report(self._node_entries(), emit=False)
+        if strag:
+            out["stragglers"] = strag
         rep = self.slo.report()
         if rep.get("objectives"):
             out["slo"] = rep["objectives"]
@@ -419,10 +540,48 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/slo":
                 self._reply(200, json.dumps(obs.render_slo(), indent=1),
                             "application/json")
+            elif path in ("/profilez", "/flightz"):
+                self._reply(405, "profilez/flightz are POST verbs "
+                                 "(POST /profilez?node=<id>&ms=<window>)",
+                            "text/plain")
             else:
                 self._reply(404, "not found: try /metrics /healthz "
-                                 "/statusz /slo",
+                                 "/statusz /slo (POST /profilez /flightz)",
                             "text/plain")
+        except Exception as e:  # noqa: BLE001 - never kill the server
+            self._reply(500, f"obs error: {e}", "text/plain")
+
+    def do_POST(self):  # noqa: N802 - http.server API
+        obs = self.server.obs
+        path, _, query = self.path.partition("?")
+        params = urllib.parse.parse_qs(query)
+
+        def q(name, default=None):
+            return (params.get(name) or [default])[0]
+
+        try:
+            if path not in ("/profilez", "/flightz"):
+                self._reply(404, "not found: POST /profilez /flightz",
+                            "text/plain")
+                return
+            node = q("node")
+            if not node:
+                self._reply(400, "missing ?node=<node_id> "
+                                 "(ids as shown on /statusz)",
+                            "text/plain")
+                return
+            wait_raw = q("wait_s")
+            wait_s = float(wait_raw) if wait_raw else None
+            if path == "/profilez":
+                directive = {"cmd": "profile", "ms": int(q("ms", "1000"))}
+            else:
+                directive = {"cmd": "flight", "reason": q("reason")}
+            res = obs.request_control(node, directive, wait_s=wait_s)
+            code = res.pop("code", 200)
+            self._reply(code, json.dumps(res, indent=1),
+                        "application/json")
+        except ValueError as e:
+            self._reply(400, f"bad parameter: {e}", "text/plain")
         except Exception as e:  # noqa: BLE001 - never kill the server
             self._reply(500, f"obs error: {e}", "text/plain")
 

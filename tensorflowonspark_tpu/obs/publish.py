@@ -10,18 +10,34 @@ where the driver's poll thread collects it.  Same wire and same
 best-effort discipline as the telemetry spool registry
 (``telemetry.register_with``): publishing must never take a worker
 down, and when ``TFOS_OBS_PORT`` is unset nothing runs at all.
+
+The daemon is also the node end of the **on-demand control plane**
+(ISSUE 16): each tick it consumes at most one directive the driver
+posted under ``obsctl:<node_id>`` (``POST /profilez`` asks for a
+``utils.profiler.trace`` capture of ``ms`` milliseconds; ``/flightz``
+for a flight-recorder dump), executes it in place, and spools the
+result — capture/dump path, or the degrade reason — back under
+``obsack:<node_id>`` for the driver to pick up.  A sick node can be
+profiled mid-run without restarting anything; a capture that cannot
+start (CPU image without the profiler backend) acks the warning instead
+of dying.
 """
 
 from __future__ import annotations
 
 import logging
 import os
+import tempfile
 import threading
 import time
 
-from tensorflowonspark_tpu.utils import metrics_registry
+from tensorflowonspark_tpu.utils import metrics_registry, telemetry
 
 logger = logging.getLogger(__name__)
+
+#: Longest on-demand profile window honored, ms (a typo'd ``ms=`` must
+#: not wedge the publish daemon for an hour).
+MAX_PROFILE_MS = 60_000
 
 
 def publish_once(mgr, node_id, role=None):
@@ -46,11 +62,77 @@ def publish_once(mgr, node_id, role=None):
         return False
 
 
+def _capture_dir(node_id):
+    """Where an on-demand capture lands: the telemetry sink dir when the
+    tracing plane is on (the driver drain already collects it), else a
+    tmpdir — the ack carries the absolute path either way."""
+    rec = telemetry._get()
+    base = rec.sink_dir if rec is not None else tempfile.gettempdir()
+    return os.path.join(base, f"profile-{node_id}-{os.getpid()}")
+
+
+def serve_control(mgr, node_id):
+    """Consume and execute at most one control directive for this node;
+    returns the ack dict that was spooled back, or None when the slot
+    was empty.  Best-effort like everything on this wire: a dead manager
+    or a broken directive is a debug line, never a worker death."""
+    try:
+        d = mgr.obs_control_take(str(node_id))
+    except Exception as e:  # noqa: BLE001 - manager gone / old manager
+        logger.debug("obs control take failed for %s: %s", node_id, e)
+        return None
+    if not isinstance(d, dict):
+        return None
+    cmd = str(d.get("cmd", ""))
+    ack = {"seq": d.get("seq"), "cmd": cmd, "node_id": str(node_id),
+           "ts": time.time(), "ok": False}
+    try:
+        if cmd == "profile":
+            ms = min(max(int(d.get("ms") or 1000), 1), MAX_PROFILE_MS)
+            from tensorflowonspark_tpu.utils import profiler
+
+            out = _capture_dir(node_id)
+            started = profiler.start_trace(out)
+            time.sleep(ms / 1000.0)
+            if started:
+                started = profiler.stop_trace()
+            ack.update(ok=bool(started), ms=ms,
+                       capture=out if started else None)
+            if not started:
+                ack["error"] = "profiler capture unavailable (no-op)"
+            metrics_registry.inc("tfos_health_captures_total",
+                                 kind="profile",
+                                 status="ok" if started else "degraded")
+        elif cmd == "flight":
+            from tensorflowonspark_tpu.obs import flight
+
+            path = flight.snapshot("health/on_demand", node=str(node_id),
+                                   reason=d.get("reason") or "on-demand")
+            ack.update(ok=path is not None, capture=path)
+            if path is None:
+                ack["error"] = "telemetry disabled: no flight ring"
+            metrics_registry.inc("tfos_health_captures_total",
+                                 kind="flight",
+                                 status="ok" if path else "degraded")
+        else:
+            ack["error"] = f"unknown cmd {cmd!r}"
+    except Exception as e:  # noqa: BLE001 - directive must still ack
+        logger.warning("obs control %r failed on %s: %s", cmd, node_id, e)
+        ack["error"] = str(e)[:200]
+    try:
+        mgr.obs_control_ack(str(node_id), ack)
+    except Exception as e:  # noqa: BLE001 - manager gone
+        logger.debug("obs control ack failed for %s: %s", node_id, e)
+    return ack
+
+
 def start_publisher(mgr, node_id, role=None, interval=None):
     """Daemon thread publishing every ``interval`` seconds
     (``TFOS_OBS_INTERVAL``); returns a stop Event, or None when the
     metrics plane is disabled.  Setting the event publishes one final
-    snapshot so short-lived processes still land their tail counts."""
+    snapshot so short-lived processes still land their tail counts.
+    Each tick also serves one pending control directive (profile /
+    flight — see :func:`serve_control`)."""
     if not metrics_registry.enabled():
         return None
     period = metrics_registry.interval() if interval is None else float(interval)
@@ -61,6 +143,7 @@ def start_publisher(mgr, node_id, role=None, interval=None):
             if not publish_once(mgr, node_id, role):
                 # manager gone: the node is exiting, stop quietly
                 return
+            serve_control(mgr, node_id)
         publish_once(mgr, node_id, role)
 
     t = threading.Thread(target=_run, name="tfos-obs-publish", daemon=True)

@@ -197,6 +197,25 @@ CATALOG = {
     "tfos_slo_breaches_total": (
         "counter", "Objective transitions into breach (burn crossing "
                    "above 1), by objective."),
+    # training-health watchtower (obs/health.py — trainer process;
+    # tfos_node_skew on the driver)
+    "tfos_health_anomalies_total": (
+        "counter", "Edge-triggered training anomalies, by kind "
+                   "(nan|loss_spike|slow_step|infeed_stall)."),
+    "tfos_health_status": (
+        "gauge", "Health of this process's training loop: 0 ok, "
+                 "1 degraded (an anomaly fired and has not cleared)."),
+    "tfos_health_last_anomaly_step": (
+        "gauge", "Step index of the most recent anomaly, by kind."),
+    "tfos_health_grad_norm": (
+        "gauge", "Device-computed global gradient norm from the last "
+                 "step (only under TFOS_HEALTH_GRADNORM=1)."),
+    "tfos_health_captures_total": (
+        "counter", "On-demand captures served by the publish daemon, "
+                   "by kind (profile|flight) and status (ok|degraded)."),
+    "tfos_node_skew": (
+        "gauge", "Driver-side straggler skew: slowest node's median "
+                 "step time over the fastest node's (1.0 = balanced)."),
 }
 
 
