@@ -860,12 +860,22 @@ def main():
 
     _run_done()
     telemetry.flush()
+    try:
+        # watchtower roll-up (anomalies seen across every lane's monitor,
+        # max straggler skew) — a non-lane key like run_stamp, proven
+        # ignored by bench_check in tests/test_health.py
+        from tensorflowonspark_tpu.obs import health as _health
+
+        health_block = _health.process_summary()
+    except Exception as e:  # noqa: BLE001 - the artifact line must go out
+        health_block = {"error": str(e)[:200]}
     print(json.dumps({
         "metric": "resnet50_train_mfu",
         "value": round(mfu, 4),
         "unit": "fraction_of_peak",
         "vs_baseline": round(mfu / 0.50, 4),
         "extra": extra,
+        "health": health_block,
         **run_stamp(),
     }))
 

@@ -69,10 +69,15 @@ else
   # serve + decode lanes run host-side on CPU-forced replicas (never a
   # second TPU claim); TFOS_BENCH_SERVE=0 / TFOS_BENCH_DECODE=0 skip
   # them if the host is too loaded for meaningful latency percentiles
+  # watchtower on in observe-only mode: the bench line's "health" block
+  # records anomalies (NaN, spikes, stalls) seen during the lanes, but
+  # never halts an unattended TPU round (docs/observability.md)
   TFOS_BENCH_SERVE="${TFOS_BENCH_SERVE:-1}" \
   TFOS_BENCH_ELASTIC_SERVE="${TFOS_BENCH_ELASTIC_SERVE:-1}" \
   TFOS_BENCH_DECODE="${TFOS_BENCH_DECODE:-1}" \
   TFOS_BENCH_DECODE_PREFIX="${TFOS_BENCH_DECODE_PREFIX:-0.6}" \
+  TFOS_HEALTH_ACTION="${TFOS_HEALTH_ACTION:-none}" \
+  TFOS_HEALTH_GRADNORM="${TFOS_HEALTH_GRADNORM:-0}" \
     session_run 7200 python bench.py
 fi
 # perf-regression gate: newest BENCH line vs prior round (host-side,

@@ -58,10 +58,14 @@ else
   # serve + decode lanes are CPU-forced (claim-safe alongside the TPU
   # claim this step holds); TFOS_BENCH_SERVE=0 / TFOS_BENCH_DECODE=0
   # to skip
+  # watchtower observe-only: the durable line's "health" block records
+  # anomalies seen during the lanes but never halts the unattended round
   TFOS_BENCH_SERVE="${TFOS_BENCH_SERVE:-1}" \
   TFOS_BENCH_ELASTIC_SERVE="${TFOS_BENCH_ELASTIC_SERVE:-1}" \
   TFOS_BENCH_DECODE="${TFOS_BENCH_DECODE:-1}" \
   TFOS_BENCH_DECODE_PREFIX="${TFOS_BENCH_DECODE_PREFIX:-0.6}" \
+  TFOS_HEALTH_ACTION="${TFOS_HEALTH_ACTION:-none}" \
+  TFOS_HEALTH_GRADNORM="${TFOS_HEALTH_GRADNORM:-0}" \
     session_run 7200 bash -c 'python bench.py > BENCH_session_r5.json.tmp \
     && mv BENCH_session_r5.json.tmp BENCH_session_r5.json \
     && cat BENCH_session_r5.json'
@@ -106,6 +110,8 @@ else
   TFOS_BENCH_ELASTIC_SERVE="${TFOS_BENCH_ELASTIC_SERVE:-1}" \
   TFOS_BENCH_DECODE="${TFOS_BENCH_DECODE:-1}" \
   TFOS_BENCH_DECODE_PREFIX="${TFOS_BENCH_DECODE_PREFIX:-0.6}" \
+  TFOS_HEALTH_ACTION="${TFOS_HEALTH_ACTION:-none}" \
+  TFOS_HEALTH_GRADNORM="${TFOS_HEALTH_GRADNORM:-0}" \
     session_run 7200 bash -c 'python bench.py > BENCH_session_r5_final.json.tmp \
     && mv BENCH_session_r5_final.json.tmp BENCH_session_r5_final.json \
     && cat BENCH_session_r5_final.json'

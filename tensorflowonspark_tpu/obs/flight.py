@@ -9,7 +9,11 @@ this module freezes that ring to disk the moment supervision notices
 something died — replica lost (serving/replicas.py ``_monitor``),
 executor respawn (engine.py ``_respawn_executor``), actor lost
 (actors/runtime.py ``_monitor``), fault-site fire (utils/faults.py) —
-so the *last N seconds before the death* survive the death.
+so the *last N seconds before the death* survive the death.  The
+training-health watchtower triggers it too: every ``health/<kind>``
+anomaly (obs/health.py) and every on-demand ``POST /flightz``
+directive (obs/publish.py ``serve_control``) snapshots the ring, so a
+NaN or a straggler leaves the same black-box evidence a crash does.
 ``tfos-postmortem`` (obs/postmortem.py) assembles the dumps plus the
 telemetry spools into a "what was everyone doing" report.
 

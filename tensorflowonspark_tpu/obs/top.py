@@ -11,11 +11,14 @@ a single snapshot and exits (the form the fast-lane test drives).
 
 Usage::
 
-    tfos-top [--url http://127.0.0.1:9090] [--interval 2] [--once] [--slo]
+    tfos-top [--url http://127.0.0.1:9090] [--interval 2] [--once]
+             [--slo] [--health]
 
 ``--url`` defaults to ``http://127.0.0.1:$TFOS_OBS_PORT``.  ``--slo``
 appends the SLO pane (one row per objective from the ``slo`` section of
 ``/statusz``: tracked value, burn rate, breach flag — ``obs/slo.py``).
+``--health`` appends the watchtower pane: per-node health state and
+anomaly counts plus the driver's straggler table (``obs/health.py``).
 """
 
 from __future__ import annotations
@@ -126,6 +129,60 @@ def render_slo(status):
     return "\n".join(lines) + "\n"
 
 
+HEALTH_COLUMNS = (
+    # (header, width, extractor) over a /statusz node entry
+    ("NODE", 14, lambda nid, e: nid),
+    ("HEALTH", 9, lambda nid, e: _s(e).get("health") or "-"),
+    ("ANOMALIES", 10, lambda nid, e: _num(_s(e).get("health_anomalies"))),
+    ("GRAD-NORM", 10, lambda nid, e: _num(_s(e).get("grad_norm"))),
+)
+
+STRAGGLER_COLUMNS = (
+    # (header, width, extractor) over one stragglers "nodes" row
+    ("NODE", 14, lambda r: r.get("node", "?")),
+    ("P50-MS", 8, lambda r: _num(r.get("p50_ms"))),
+    ("STEPS", 7, lambda r: _num(r.get("steps"))),
+    ("REL", 6, lambda r: _rel(r.get("rel"))),
+)
+
+
+def _rel(v):
+    return "-" if v is None else f"{float(v):.2f}x"
+
+
+def render_health(status):
+    """The --health pane text: per-node watchtower state plus the
+    driver's straggler report (obs/health.py, docs/observability.md)."""
+    lines = ["", "health (obs/health.py):"]
+    nodes = status.get("nodes") or {}
+    rows = [(nid, ent) for nid, ent in sorted(nodes.items())
+            if _s(ent).get("health") is not None]
+    if rows:
+        lines.append(" ".join(
+            h.ljust(w) for h, w, _ in HEALTH_COLUMNS).rstrip())
+        for nid, ent in rows:
+            lines.append(" ".join(
+                str(fn(nid, ent))[:w].ljust(w)
+                for _, w, fn in HEALTH_COLUMNS).rstrip())
+    else:
+        lines.append("  (no health reports)")
+    st = status.get("stragglers")
+    if st:
+        lines.append(
+            f"stragglers: skew={_rel(st.get('skew'))} "
+            f"slowest={st.get('slowest', '?')} "
+            f"fastest={st.get('fastest', '?')}")
+        lines.append(" ".join(
+            h.ljust(w) for h, w, _ in STRAGGLER_COLUMNS).rstrip())
+        for row in st.get("nodes") or []:
+            lines.append(" ".join(
+                str(fn(row))[:w].ljust(w)
+                for _, w, fn in STRAGGLER_COLUMNS).rstrip())
+    else:
+        lines.append("stragglers: (not enough per-node step data)")
+    return "\n".join(lines) + "\n"
+
+
 def fetch_statusz(url, timeout=5):
     """GET <url>/statusz and parse it; raises URLError/ValueError."""
     with urllib.request.urlopen(url.rstrip("/") + "/statusz",
@@ -171,6 +228,8 @@ def build_parser():
                    help="print one snapshot and exit")
     p.add_argument("--slo", action="store_true",
                    help="append the SLO pane (objective, current, burn)")
+    p.add_argument("--health", action="store_true",
+                   help="append the health pane (anomalies, stragglers)")
     return p
 
 
@@ -197,6 +256,8 @@ def main(argv=None, out=None):
         text = render(status)
         if args.slo:
             text += render_slo(status)
+        if args.health:
+            text += render_health(status)
         if args.once:
             out.write(text)
             out.flush()
