@@ -1,0 +1,851 @@
+"""Training-health watchtower (obs/health.py) acceptance.
+
+Parity framing: the reference's failure story for a diverging run is
+"read the executor logs" (SURVEY.md §5); these tests pin the watching
+replacement — edge-triggered streaming detectors over the existing
+``TrainMetrics`` feed, deterministic NaN injection through the fault
+plan's ``poison`` channel, configurable reactions
+(``TFOS_HEALTH_ACTION=checkpoint|halt``), driver-side straggler
+analysis on ``/statusz``, and the on-demand profiling control plane
+(``POST /profilez`` / ``/flightz``).
+
+Fast lane: detector math, re-arm semantics, reactions, fault grammar,
+profiler degrade, straggler report, endpoint rendering, ``tfos-top
+--health``, the bench ``health`` block contract, and a CPU control-plane
+round trip.  Slow lane: the two ISSUE 16 e2e scenarios — a seeded NaN
+halting a cluster run with a checkpoint at the last finite step, and a
+seeded-slow executor named by the straggler table.
+"""
+
+import glob
+import importlib.util
+import io
+import json
+import logging
+import math
+import os
+import subprocess
+import sys
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tensorflowonspark_tpu import cluster as TFCluster
+from tensorflowonspark_tpu import manager as tfmanager
+from tensorflowonspark_tpu.cluster import InputMode
+from tensorflowonspark_tpu.engine import LocalEngine, TaskError
+from tensorflowonspark_tpu.obs import health
+from tensorflowonspark_tpu.obs import http as obs_http
+from tensorflowonspark_tpu.obs import publish as obs_publish
+from tensorflowonspark_tpu.obs import top as obs_top
+from tensorflowonspark_tpu.utils import faults, telemetry
+from tensorflowonspark_tpu.utils import metrics_registry as reg
+from tensorflowonspark_tpu.utils.metrics import TrainMetrics
+
+pytestmark = pytest.mark.health
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH_CHECK = os.path.join(REPO, "scripts", "bench_check.py")
+
+_ENV_KEYS = (
+    reg.PORT_ENV, reg.INTERVAL_ENV, obs_http.HOST_ENV,
+    health.ENABLE_ENV, health.ACTION_ENV, health.GRADNORM_ENV,
+    health.SPIKE_SIGMA_ENV, health.WARMUP_ENV, health.STEP_FACTOR_ENV,
+    health.STEP_PATIENCE_ENV, health.STALL_FRAC_ENV,
+    faults.PLAN_ENV, faults.EXECUTOR_ENV, "TFOS_EXECUTOR_INDEX",
+    telemetry.DIR_ENV, telemetry.SPOOL_ENV, telemetry.NODE_ENV,
+    telemetry.ROLE_ENV,
+)
+
+
+@pytest.fixture(autouse=True)
+def _health_env():
+    """Every test starts with the obs gate off, no fault plan, no
+    telemetry, default detector knobs, and clean per-process caches."""
+    saved = {k: os.environ.get(k) for k in _ENV_KEYS}
+    for k in _ENV_KEYS:
+        os.environ.pop(k, None)
+    reg.reset()
+    faults._reset_for_tests()
+    health._LAST_STRAGGLERS.clear()
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    reg.reset()
+    faults._reset_for_tests()
+    health._LAST_STRAGGLERS.clear()
+
+
+def _enable(port="0", interval="0.2"):
+    os.environ[reg.PORT_ENV] = port
+    os.environ[reg.INTERVAL_ENV] = interval
+    reg.reset()
+
+
+def _get(url, timeout=30):
+    """GET that returns (code, body) even for error statuses (503 from a
+    degraded /healthz must be readable, not an exception)."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read().decode("utf-8")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode("utf-8")
+
+
+def _post(url, timeout=90):
+    req = urllib.request.Request(url, data=b"", method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read().decode("utf-8")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode("utf-8")
+
+
+def _read_all(root):
+    text = ""
+    for path in glob.glob(os.path.join(str(root), "**", "*"),
+                          recursive=True):
+        if os.path.isfile(path):
+            with open(path, errors="replace") as f:
+                text += f.read()
+    return text
+
+
+# --- detectors: edge trigger + re-arm ---------------------------------------
+
+def test_nan_gate_edge_trigger_and_rearm():
+    m = health.HealthMonitor(action="none")
+    assert m.observe_step(loss=1.0, step=1) == []
+    assert m.last_finite_step == 1
+    assert m.observe_step(loss=float("nan"), step=2) == ["nan"]
+    assert m.status == "degraded"
+    # still anomalous: edge-triggered means no second firing
+    assert m.observe_step(loss=float("nan"), step=3) == []
+    # recovery re-arms ...
+    assert m.observe_step(loss=0.9, step=4) == []
+    assert m.status == "ok" and m.last_finite_step == 4
+    # ... so the next non-finite value fires again (inf counts too)
+    assert m.observe_step(loss=float("inf"), step=5) == ["nan"]
+    assert m.counts == {"nan": 2}
+    assert m.last_anomaly["kind"] == "nan"
+    assert m.last_anomaly["step"] == 5
+    assert m.last_anomaly["last_finite_step"] == 4
+
+
+def test_nan_gate_covers_grad_probe():
+    m = health.HealthMonitor(action="none")
+    assert m.observe_step(loss=1.0, grad_norm=2.0, grad_finite=True,
+                          step=1) == []
+    assert m.observe_step(loss=1.0, grad_norm=float("nan"), step=2) == ["nan"]
+    assert m.last_anomaly["source"] == "grad_norm"
+    assert m.observe_step(loss=1.0, grad_norm=1.0, step=3) == []  # re-arm
+    # an all-finite-values step with grad_finite=False (the device-side
+    # any-nan-in-tree probe) is still numeric corruption
+    assert m.observe_step(loss=1.0, grad_finite=False, step=4) == ["nan"]
+    assert m.last_anomaly["source"] == "grad_finite"
+    # a non-finite step never advances the finite high-water mark
+    assert m.last_finite_step == 3
+
+
+def test_loss_spike_detector():
+    os.environ[health.WARMUP_ENV] = "5"
+    m = health.HealthMonitor(action="none")
+    for i in range(6):
+        assert m.observe_step(loss=1.0, step=i + 1) == []
+    assert m.observe_step(loss=100.0, step=7) == ["loss_spike"]
+    assert m.last_anomaly["kind"] == "loss_spike"
+    assert m.last_anomaly["loss"] == 100.0
+    # back under the (spike-inflated) threshold: re-arm
+    assert m.observe_step(loss=1.0, step=8) == []
+    assert m.status == "ok"
+    # a second excursion fires a second event
+    assert m.observe_step(loss=1000.0, step=9) == ["loss_spike"]
+    assert m.counts["loss_spike"] == 2
+
+
+def test_slow_step_patience_and_baseline_exclusion():
+    os.environ[health.WARMUP_ENV] = "3"
+    os.environ[health.STEP_PATIENCE_ENV] = "2"
+    m = health.HealthMonitor(action="none")
+    for i in range(4):
+        assert m.observe_step(step_time_s=0.01, step=i + 1) == []
+    # one slow step is tolerated (patience=2) ...
+    assert m.observe_step(step_time_s=0.05, step=5) == []
+    # ... the second consecutive one fires
+    assert m.observe_step(step_time_s=0.05, step=6) == ["slow_step"]
+    assert m.status == "degraded"
+    assert m.observe_step(step_time_s=0.05, step=7) == []  # edge
+    # slow steps never entered the EWMA: the baseline is still the
+    # healthy 10ms, not converging toward the regression
+    assert m._time_mean == pytest.approx(0.01)
+    assert m.observe_step(step_time_s=0.01, step=8) == []  # re-arm
+    assert m.status == "ok" and m._slow_run == 0
+
+
+def test_infeed_stall_detector():
+    os.environ[health.WARMUP_ENV] = "6"
+    m = health.HealthMonitor(action="none")
+    # warmup counts loss+time observations; quiet until it is met
+    for i in range(2):
+        assert m.observe_step(loss=1.0, step_time_s=0.01, infeed_frac=0.9,
+                              step=i + 1) == []
+    assert m.observe_step(loss=1.0, step_time_s=0.01, infeed_frac=0.9,
+                          step=3) == ["infeed_stall"]
+    assert m.observe_step(loss=1.0, step_time_s=0.01, infeed_frac=0.1,
+                          step=4) == []  # recovered: re-arm
+    assert m.status == "ok"
+    assert m.observe_step(loss=1.0, step_time_s=0.01, infeed_frac=0.8,
+                          step=5) == ["infeed_stall"]
+    assert m.counts["infeed_stall"] == 2
+
+
+# --- reactions --------------------------------------------------------------
+
+def test_reaction_checkpoint_and_halt():
+    calls = []
+    m = health.HealthMonitor(action="checkpoint",
+                             checkpoint_fn=lambda: calls.append("ck"))
+    m.observe_step(loss=1.0, step=1)
+    assert m.observe_step(loss=float("nan"), step=2) == ["nan"]
+    assert calls == ["ck"]  # checkpointed, run continues
+
+    halts = []
+    m2 = health.HealthMonitor(action="halt",
+                              checkpoint_fn=lambda: halts.append("ck"))
+    m2.observe_step(loss=2.0, step=1)
+    with pytest.raises(health.HealthHalt, match="last finite step 1"):
+        m2.observe_step(loss=float("nan"), step=2)
+    assert halts == ["ck"]  # checkpoint BEFORE the halt
+
+
+def test_advisory_kinds_never_react():
+    os.environ[health.WARMUP_ENV] = "2"
+    m = health.HealthMonitor(action="halt")
+    for i in range(3):
+        m.observe_step(loss=1.0, step=i + 1)
+    # a loss spike under action=halt is advisory: fires, no HealthHalt
+    assert m.observe_step(loss=100.0, step=4) == ["loss_spike"]
+
+
+def test_halt_survives_broken_checkpoint_fn():
+    def boom():
+        raise OSError("disk full")
+
+    m = health.HealthMonitor(action="halt", checkpoint_fn=boom)
+    m.observe_step(loss=1.0, step=1)
+    with pytest.raises(health.HealthHalt):
+        m.observe_step(loss=float("nan"), step=2)
+
+
+def test_action_env_and_enable_gate():
+    os.environ[health.ACTION_ENV] = "explode"
+    assert health.action_from_env() == "none"  # typo warns, never halts
+    os.environ[health.ACTION_ENV] = "halt"
+    assert health.action_from_env() == "halt"
+    assert health.HealthMonitor().action == "halt"
+    with pytest.raises(ValueError):
+        health.HealthMonitor(action="explode")
+    os.environ[health.ENABLE_ENV] = "0"
+    assert not health.enabled()
+    assert health.monitor_from_env() is None
+    assert TrainMetrics(health=False).health is None
+    os.environ.pop(health.ENABLE_ENV)
+    assert health.enabled()  # default on
+    assert isinstance(health.monitor_from_env(), health.HealthMonitor)
+    assert isinstance(TrainMetrics().health, health.HealthMonitor)
+
+
+# --- a firing lands on all three planes -------------------------------------
+
+def test_fire_lands_metrics_telemetry_and_flight(tmp_path):
+    _enable()
+    os.environ[telemetry.DIR_ENV] = str(tmp_path)
+    m = health.HealthMonitor(action="none", node="worker-7")
+    m.observe_step(loss=1.0, grad_norm=1.5, step=1)
+    m.observe_step(loss=float("nan"), step=2)
+
+    snap = reg.snapshot()
+    assert health.snapshot_anomaly_total(snap) == 1
+    (s,) = snap["tfos_health_anomalies_total"]["series"]
+    assert s["labels"] == {"kind": "nan"} and s["value"] == 1.0
+    assert obs_http._metric_gauge(snap, "tfos_health_status") == 1.0
+    assert obs_http._metric_gauge(snap, "tfos_health_last_anomaly_step") == 2.0
+    assert obs_http._metric_gauge(snap, "tfos_health_grad_norm") == 1.5
+    summary = obs_http.node_summary(snap)
+    assert summary["health"] == "degraded"
+    assert summary["health_anomalies"] == 1
+    assert summary["grad_norm"] == 1.5
+
+    telemetry.flush()
+    assert '"health/nan"' in _read_all(tmp_path)
+    # the flight ring froze while the anomaly was fresh (ISSUE 16
+    # satellite: health/* joins the supervision dump triggers)
+    (dump_path,) = glob.glob(str(tmp_path / "flight-*.json"))
+    dump = json.loads(open(dump_path).read())
+    assert dump["trigger"] == "health/nan"
+    assert dump["node"] == "worker-7"
+    assert "nan at step 2" in dump["reason"]
+
+
+def test_process_summary_is_bench_ready():
+    _enable()
+    m = health.HealthMonitor(action="none")
+    m.observe_step(loss=float("nan"), step=1)
+    ps = health.process_summary()
+    assert ps["anomalies"].get("nan", 0) >= 1
+    assert ps["total"] >= 1
+    assert ps["status"] == "degraded"
+    assert ps["max_skew"] is None  # no straggler report yet
+    json.dumps(ps)  # bench.py embeds it in the JSON line verbatim
+
+
+# --- fault grammar: the nan poison channel ----------------------------------
+
+def test_fault_plan_nan_parse():
+    (f,) = faults.parse_plan("train.step:nan@3")
+    assert f.site == "train.step" and f.kind == "nan"
+    assert f.first == 3 and f.last == 3
+    with pytest.raises(ValueError):
+        faults.parse_plan("train.step:nan@x")
+    with pytest.raises(ValueError):
+        faults.parse_plan("nowhere.site:nan@1")
+
+
+def test_poison_counts_separately_from_check():
+    os.environ[faults.PLAN_ENV] = "train.step:nan@3"
+    faults._reset_for_tests()
+    # check() must neither fire a nan entry nor consume its hits
+    for _ in range(10):
+        faults.check("train.step")
+    assert faults.poison("train.step", 1.5) == 1.5       # hit 1
+    assert faults.poison("train.step", 1.5) == 1.5       # hit 2
+    assert math.isnan(faults.poison("train.step", 1.5))  # hit 3 fires
+    assert faults.poison("train.step", 1.5) == 1.5       # hit 4: done
+
+
+def test_poison_honors_executor_scope():
+    os.environ[faults.PLAN_ENV] = "train.step:nan@1"
+    os.environ[faults.EXECUTOR_ENV] = "1"
+    os.environ["TFOS_EXECUTOR_INDEX"] = "0"
+    faults._reset_for_tests()
+    assert faults.poison("train.step", 2.0) == 2.0  # scoped out
+    os.environ["TFOS_EXECUTOR_INDEX"] = "1"
+    faults._reset_for_tests()
+    assert math.isnan(faults.poison("train.step", 2.0))
+
+
+def test_train_metrics_poison_to_halt():
+    """The deterministic NaN path end to end in one process: the fault
+    plan poisons the 3rd recorded loss, TrainMetrics hands it to the
+    monitor, the halt reaction checkpoints at the last finite step and
+    raises out of ``step()``."""
+    os.environ[faults.PLAN_ENV] = "train.step:nan@3"
+    os.environ[health.ACTION_ENV] = "halt"
+    faults._reset_for_tests()
+    saved = []
+    mon = health.monitor_from_env()
+    mon.checkpoint_fn = lambda: saved.append(mon.last_finite_step)
+    tm = TrainMetrics(health=mon)
+    tm.step(items=1, loss=1.0)
+    tm.step(items=1, loss=0.9)
+    with pytest.raises(health.HealthHalt):
+        tm.step(items=1, loss=0.8)
+    assert saved == [2]
+    assert mon.last_finite_step == 2
+
+
+# --- profiler degrade-to-noop (satellite a) ---------------------------------
+
+def test_profiler_degrades_to_noop(monkeypatch, caplog, tmp_path):
+    jax = pytest.importorskip("jax")
+    from tensorflowonspark_tpu.utils import profiler
+
+    def boom(*a, **k):
+        raise RuntimeError("no capture backend")
+
+    monkeypatch.setattr(profiler, "_degraded_warned", False)
+    monkeypatch.setattr(jax.profiler, "start_trace", boom)
+    stops = []
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: stops.append(1))
+    with caplog.at_level(logging.WARNING,
+                         logger="tensorflowonspark_tpu.utils.profiler"):
+        assert profiler.start_trace(str(tmp_path)) is False
+        assert profiler.start_trace(str(tmp_path)) is False
+    warns = [r for r in caplog.records
+             if "capture unavailable" in r.getMessage()
+             and r.levelno >= logging.WARNING]
+    assert len(warns) == 1  # warned once, then quiet
+    ran = []
+    with profiler.trace(str(tmp_path)):
+        ran.append(1)
+    assert ran == [1]      # the body always runs
+    assert stops == []     # a trace that never started is never stopped
+
+
+class _FakeCtlMgr:
+    """Just the control-channel surface serve_control touches."""
+
+    def __init__(self):
+        self.kv_ = {}
+
+    def obs_control_take(self, nid):
+        return self.kv_.pop("ctl:" + nid, None)
+
+    def obs_control_ack(self, nid, res):
+        self.kv_["ack:" + nid] = res
+
+
+def test_serve_control_acks_degraded_capture(monkeypatch):
+    """A node without a profiler backend acks the degrade reason instead
+    of dying, and counts the degraded capture."""
+    jax = pytest.importorskip("jax")
+    from tensorflowonspark_tpu.utils import profiler
+
+    _enable()
+    monkeypatch.setattr(profiler, "_degraded_warned", True)
+    monkeypatch.setattr(
+        jax.profiler, "start_trace",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("nope")))
+    fm = _FakeCtlMgr()
+    fm.kv_["ctl:w0"] = {"cmd": "profile", "ms": 10, "seq": 7}
+    ack = obs_publish.serve_control(fm, "w0")
+    assert ack["ok"] is False and ack["seq"] == 7
+    assert ack["error"] == "profiler capture unavailable (no-op)"
+    assert fm.kv_["ack:w0"] == ack
+    (s,) = reg.snapshot()["tfos_health_captures_total"]["series"]
+    assert s["labels"] == {"kind": "profile", "status": "degraded"}
+    # an unknown command still acks (the driver's 200 must carry why)
+    fm.kv_["ctl:w0"] = {"cmd": "zap", "seq": 8}
+    ack = obs_publish.serve_control(fm, "w0")
+    assert ack["seq"] == 8 and "unknown cmd" in ack["error"]
+    assert obs_publish.serve_control(fm, "w0") is None  # empty slot
+
+
+# --- driver-side straggler analysis -----------------------------------------
+
+def _hist(counts, count):
+    return {"labels": {}, "bounds": [10.0, 100.0], "counts": counts,
+            "count": count, "sum": 0.0}
+
+
+def _entry(h):
+    return {"metrics": {
+        "tfos_train_step_ms": {"type": "histogram", "series": [h]}}}
+
+
+def test_straggler_report_math():
+    entries = {
+        "worker-0": _entry(_hist([4, 0, 0], 4)),   # p50 ~5ms
+        "worker-1": _entry(_hist([0, 4, 0], 4)),   # p50 ~55ms
+        "worker-2": _entry(_hist([1, 0, 0], 1)),   # < min_count: excluded
+        "ps-0": {"metrics": {}},                   # no histogram: excluded
+    }
+    rep = health.straggler_report(entries, emit=False)
+    assert rep["slowest"] == "worker-1" and rep["fastest"] == "worker-0"
+    assert rep["skew"] > 1.5
+    rows = {r["node"]: r for r in rep["nodes"]}
+    assert set(rows) == {"worker-0", "worker-1"}
+    assert rows["worker-0"]["rel"] == 1.0
+    assert rows["worker-1"]["rel"] == rep["skew"]
+    assert rows["worker-1"]["p50_ms"] > rows["worker-0"]["p50_ms"]
+    # a single comparable node is no cross-node statement
+    assert health.straggler_report(
+        {"worker-0": _entry(_hist([4, 0, 0], 4))}) is None
+    assert health.straggler_report({}) is None
+
+
+def test_straggler_emit_sets_gauge_and_summary_cache():
+    _enable()
+    entries = {"worker-0": _entry(_hist([4, 0, 0], 4)),
+               "worker-1": _entry(_hist([0, 4, 0], 4))}
+    rep = health.straggler_report(entries)  # emit=True default
+    assert obs_http._metric_gauge(
+        reg.snapshot(), "tfos_node_skew") == rep["skew"]
+    ps = health.process_summary()
+    assert ps["max_skew"] == rep["skew"]
+    assert ps["slowest_node"] == "worker-1"
+
+
+# --- /statusz stragglers + /healthz degraded --------------------------------
+
+def test_statusz_stragglers_and_healthz_degraded():
+    _enable()
+    srv = obs_http.ObsServer(cluster=None, port=0, interval=999).start()
+    try:
+        now = time.time()
+        snap_fast = {"tfos_train_step_ms": {
+            "type": "histogram", "series": [_hist([4, 0, 0], 4)]}}
+        snap_slow = {
+            "tfos_train_step_ms": {
+                "type": "histogram", "series": [_hist([0, 4, 0], 4)]},
+            "tfos_health_anomalies_total": {"type": "counter", "series": [
+                {"labels": {"kind": "slow_step"}, "value": 2.0}]},
+            "tfos_health_status": {"type": "gauge", "series": [
+                {"labels": {}, "value": 1.0}]},
+        }
+        with srv._lock:
+            srv._nodes["worker-0"] = {
+                "node_id": "worker-0", "role": "worker",
+                "heartbeat_age_s": 0.1, "last_seen": now,
+                "metrics": snap_fast, "polled_ts": now}
+            srv._nodes["worker-1"] = {
+                "node_id": "worker-1", "role": "worker",
+                "heartbeat_age_s": 0.1, "last_seen": now,
+                "metrics": snap_slow, "polled_ts": now}
+
+        code, body = _get(srv.url + "/statusz")
+        assert code == 200
+        doc = json.loads(body)
+        strag = doc["stragglers"]
+        assert strag["slowest"] == "worker-1" and strag["skew"] > 1.5
+        assert doc["nodes"]["worker-1"]["summary"]["health"] == "degraded"
+        assert doc["nodes"]["worker-1"]["summary"]["health_anomalies"] == 2
+        assert "health" not in doc["nodes"]["worker-0"]["summary"]
+
+        # anomalies flip /healthz to degraded (still 503: don't route
+        # work at a sick cluster) even with every heartbeat live
+        code, body = _get(srv.url + "/healthz")
+        assert code == 503
+        doc = json.loads(body)
+        assert doc["status"] == "degraded"
+        assert doc["nodes"]["worker-1"]["anomalies"] == 2
+        assert "anomalies" not in doc["nodes"]["worker-0"]
+        assert all(n["alive"] for n in doc["nodes"].values())
+
+        # GET /statusz recomputes without emitting; the poll thread owns
+        # the driver-registry gauge
+        assert obs_http._metric_gauge(
+            reg.snapshot() or {}, "tfos_node_skew") is None
+        srv.poll_once()
+        assert obs_http._metric_gauge(
+            reg.snapshot(), "tfos_node_skew") == strag["skew"]
+    finally:
+        srv.stop()
+
+
+def test_healthz_degrades_on_driver_own_registry():
+    _enable()
+    reg.inc("tfos_health_anomalies_total", kind="nan")
+    srv = obs_http.ObsServer(cluster=None, port=0, interval=999).start()
+    try:
+        code, body = _get(srv.url + "/healthz")
+        assert code == 503
+        assert json.loads(body)["status"] == "degraded"
+    finally:
+        srv.stop()
+
+
+# --- tfos-top --health (satellite d) ----------------------------------------
+
+_CANNED_HEALTH = {
+    "cluster": {"id": "abcd1234", "epoch": 0, "num_executors": 2,
+                "restarts": 0, "restarts_used": 0},
+    "nodes": {
+        "worker-0": {"role": "worker", "alive": True,
+                     "summary": {"steps": 50, "health": "degraded",
+                                 "health_anomalies": 3, "grad_norm": 12.25}},
+        "worker-1": {"role": "worker", "alive": True,
+                     "summary": {"steps": 50}},  # no health report
+    },
+    "stragglers": {
+        "skew": 2.4, "slowest": "worker-1", "fastest": "worker-0",
+        "nodes": [
+            {"node": "worker-0", "p50_ms": 10.0, "steps": 50, "rel": 1.0},
+            {"node": "worker-1", "p50_ms": 24.0, "steps": 50, "rel": 2.4},
+        ]},
+}
+
+
+class _StatuszStub(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        body = json.dumps(_CANNED_HEALTH).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def test_tfos_top_health_pane():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _StatuszStub)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        out = io.StringIO()
+        assert obs_top.main(["--url", url, "--once", "--health"],
+                            out=out) == 0
+        text = out.getvalue()
+        assert "health (obs/health.py):" in text
+        assert "ANOMALIES" in text and "GRAD-NORM" in text
+        lines = text.splitlines()
+        hdr = lines.index("health (obs/health.py):")
+        pane = lines[hdr:]
+        (w0,) = [ln for ln in pane if ln.startswith("worker-0")
+                 and "degraded" in ln]
+        assert "3" in w0
+        # worker-1 never reported health: no row in the health table
+        # (its only pane appearance is the straggler table)
+        assert ("stragglers: skew=2.40x slowest=worker-1 "
+                "fastest=worker-0") in text
+        (sl,) = [ln for ln in pane if ln.startswith("worker-1")
+                 and "2.40x" in ln]
+        assert "24" in sl
+        # without --health the pane stays hidden
+        out2 = io.StringIO()
+        assert obs_top.main(["--url", url, "--once"], out=out2) == 0
+        assert "health (obs/health.py):" not in out2.getvalue()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    empty = obs_top.render_health({})
+    assert "(no health reports)" in empty
+    assert "stragglers: (not enough per-node step data)" in empty
+
+
+# --- bench "health" block is non-lane metadata (satellite c) ----------------
+
+def test_bench_health_block_ignored_by_bench_check(tmp_path):
+    spec = importlib.util.spec_from_file_location("bench_check", BENCH_CHECK)
+    bc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bc)
+
+    plain = {"metric": "resnet_train_mfu", "value": 0.4, "unit": "frac",
+             "extra": {"images_per_sec_per_chip": 2500.0}}
+    stamped = dict(plain, health={
+        "anomalies": {"loss_spike": 1}, "total": 1, "status": "degraded",
+        "max_skew": 1.2, "slowest_node": "worker-1"})
+    assert bc.lanes_of(stamped) == bc.lanes_of(plain)
+
+    (tmp_path / "old.json").write_text(json.dumps(plain))
+    (tmp_path / "new.json").write_text(json.dumps(stamped))
+    proc = subprocess.run(
+        [sys.executable, BENCH_CHECK, "--dir", str(tmp_path),
+         "--baseline", str(tmp_path / "old.json"),
+         "--latest", str(tmp_path / "new.json")],
+        capture_output=True, text=True,
+        env=dict(os.environ, PYTHONPATH=""), timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# --- on-demand control plane round trip (CPU) -------------------------------
+
+def test_profilez_flightz_roundtrip(tmp_path, monkeypatch):
+    """The acceptance scenario on CPU: POST /profilez round-trips a
+    capture directive through the manager KV to a live publish daemon
+    and back; /flightz returns an on-demand flight dump path; the
+    failure codes (404 unknown node, 400 missing param, 405 on GET) are
+    pinned."""
+    pytest.importorskip("jax")
+    _enable(port="0", interval="0.2")
+    monkeypatch.setenv(telemetry.DIR_ENV, str(tmp_path))
+    mgr = tfmanager.start(b"hp-secret", ("control",), "local")
+    stop = None
+    srv = None
+    try:
+        meta = {"job_name": "worker", "task_index": 0, "executor_id": 0,
+                "host": "127.0.0.1", "addr": list(mgr.address),
+                "authkey": b"hp-secret".hex()}
+        fake_cluster = types.SimpleNamespace(cluster_info=[meta])
+        node_mgr = tfmanager.connect(tuple(mgr.address), b"hp-secret")
+        reg.inc("tfos_engine_jobs_total")  # something worth publishing
+        stop = obs_publish.start_publisher(node_mgr, "worker-0",
+                                           role="worker", interval=0.2)
+        assert stop is not None
+        srv = obs_http.ObsServer(cluster=fake_cluster, port=0,
+                                 interval=0.2).start()
+
+        code, body = _post(srv.url + "/profilez?node=worker-0&ms=100"
+                           "&wait_s=60")
+        res = json.loads(body)
+        assert code == 200, res
+        assert res["cmd"] == "profile" and res["node_id"] == "worker-0"
+        assert res["ok"] is True, res
+        assert res["ms"] == 100
+        # the capture landed in the telemetry sink dir for the drain
+        assert res["capture"].startswith(str(tmp_path))
+
+        code, body = _post(srv.url + "/flightz?node=worker-0&wait_s=60")
+        res = json.loads(body)
+        assert code == 200 and res["cmd"] == "flight"
+        assert res["ok"] is True, res
+        dump = json.loads(open(res["capture"]).read())
+        assert dump["trigger"] == "health/on_demand"
+        assert dump["node"] == "worker-0"
+
+        snap = reg.snapshot()
+        caps = {(s["labels"]["kind"], s["labels"]["status"]): s["value"]
+                for s in snap["tfos_health_captures_total"]["series"]}
+        assert caps[("profile", "ok")] == 1.0
+        assert caps[("flight", "ok")] == 1.0
+
+        code, body = _post(srv.url + "/profilez?node=worker-9")
+        assert code == 404 and "unknown node" in body
+        code, body = _post(srv.url + "/profilez")
+        assert code == 400 and "node" in body
+        code, body = _get(srv.url + "/profilez")
+        assert code == 405
+    finally:
+        if srv is not None:
+            srv.stop()
+        if stop is not None:
+            stop.set()
+        mgr.shutdown()
+
+
+# --- e2e (slow lane): seeded NaN halt + seeded straggler --------------------
+
+def _nan_halt_main(args, ctx):
+    import numpy as np
+
+    from tensorflowonspark_tpu.obs import health as H
+    from tensorflowonspark_tpu.utils import checkpoint as ckpt
+    from tensorflowonspark_tpu.utils.metrics import TrainMetrics as TM
+
+    ckpt_dir = os.path.join(args["model_dir"], f"worker-{ctx.task_index}")
+    mon = H.monitor_from_env(node=f"worker-{ctx.task_index}")
+    mon.checkpoint_fn = lambda: ckpt.save_checkpoint(
+        ckpt_dir, {"w": np.zeros(1, np.float32)},
+        step=mon.last_finite_step)
+    tm = TM(health=mon)
+    for i in range(600):
+        tm.step(items=1, loss=1.0 + 0.001 * i)
+        time.sleep(0.02)
+
+
+@pytest.mark.slow
+def test_e2e_nan_halt_checkpoints_last_finite_step(tmp_path, monkeypatch):
+    """ISSUE 16 acceptance: a NaN injected at a known step fires
+    ``health/nan``, writes a flight dump, flips /healthz to degraded,
+    and TFOS_HEALTH_ACTION=halt stops the run with a checkpoint at the
+    last finite step."""
+    from tensorflowonspark_tpu.utils import checkpoint as ckpt
+
+    telemetry_dir = tmp_path / "telemetry"
+    monkeypatch.setenv(telemetry.DIR_ENV, str(telemetry_dir))
+    monkeypatch.setenv(reg.PORT_ENV, "0")
+    monkeypatch.setenv(reg.INTERVAL_ENV, "0.1")
+    monkeypatch.chdir(tmp_path)
+    reg.reset()
+    engine = LocalEngine(2, env={
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": "",
+        faults.PLAN_ENV: "train.step:nan@8",
+        health.ACTION_ENV: "halt",
+    })
+    degraded = None
+    try:
+        cluster = TFCluster.run(
+            engine, _nan_halt_main, {"model_dir": str(tmp_path / "model")},
+            num_executors=2, input_mode=InputMode.TENSORFLOW)
+        assert cluster.obs is not None
+        base = cluster.obs.url
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            code, body = _get(base + "/healthz")
+            doc = json.loads(body)
+            if code == 503 and doc["status"] == "degraded":
+                degraded = doc
+                break
+            time.sleep(0.3)
+        assert degraded is not None, "healthz never degraded"
+        assert any(n.get("anomalies") for n in degraded["nodes"].values())
+        try:
+            cluster.shutdown(grace_secs=2)
+        except (TaskError, RuntimeError, SystemExit):
+            pass  # halted workers skipped the exit barrier: acceptable
+        # the flight recorder froze the ring at the anomaly; dumps live
+        # in the executors' spool dirs, which engine.stop() deletes with
+        # the scratch root (only *.jsonl is drained) — collect them now
+        dumps = []
+        for d in engine.executor_dirs:
+            # the spool is a dotdir, which "**" globs skip — name it
+            dumps += glob.glob(os.path.join(str(d), ".tfos_telemetry",
+                                            "flight-*.json"))
+        assert dumps, "no flight dump written on health/nan"
+        assert any(json.loads(open(p).read())["trigger"] == "health/nan"
+                   for p in dumps)
+    finally:
+        engine.stop()
+        for k in (telemetry.NODE_ENV, telemetry.ROLE_ENV,
+                  telemetry.SPOOL_ENV):
+            os.environ.pop(k, None)
+
+    # nan@8 poisons the 8th recorded loss: both workers checkpointed at
+    # the last finite step, 7 — deterministically
+    for i in range(2):
+        step = ckpt.latest_step(str(tmp_path / "model" / f"worker-{i}"))
+        assert step == 7, f"worker-{i} checkpointed at {step}, wanted 7"
+
+    raw = _read_all(tmp_path)
+    assert "health/nan" in raw       # the anomaly event
+    assert "health/halt" in raw      # wrapper_fn's clean-stop event
+    assert "fault/injected" in raw   # the poison left its injection mark
+
+
+def _straggler_main(args, ctx):
+    from tensorflowonspark_tpu.utils.metrics import TrainMetrics as TM
+
+    tm = TM(health=False)
+    for _ in range(args["steps"]):
+        tm.step(items=1)
+        time.sleep(0.005)
+
+
+@pytest.mark.slow
+def test_e2e_straggler_named_in_statusz(tmp_path, monkeypatch):
+    """ISSUE 16 acceptance: a seeded-slow node in a multiprocess run is
+    named by the straggler table with the skew attributed."""
+    monkeypatch.setenv(reg.PORT_ENV, "0")
+    monkeypatch.setenv(reg.INTERVAL_ENV, "0.1")
+    monkeypatch.chdir(tmp_path)
+    reg.reset()
+    engine = LocalEngine(2, env={
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": "",
+        faults.PLAN_ENV: "train.step:delay(0.05)@*",
+        faults.EXECUTOR_ENV: "1",   # only worker-1 drags
+    })
+    try:
+        cluster = TFCluster.run(
+            engine, _straggler_main, {"steps": 120},
+            num_executors=2, input_mode=InputMode.TENSORFLOW)
+        assert cluster.obs is not None
+        base = cluster.obs.url
+        strag = None
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            _, body = _get(base + "/statusz")
+            doc = json.loads(body)
+            s = doc.get("stragglers")
+            if s and s["slowest"] == "worker-1" and s["skew"] > 2.0:
+                # the poll thread (the only emitter) must also have
+                # exported the skew gauge on the driver /metrics series —
+                # its tick can trail the statusz view by one interval
+                _, text = _get(base + "/metrics")
+                if "tfos_node_skew" in text:
+                    strag = s
+                    break
+            time.sleep(0.3)
+        assert strag is not None, "straggler table never named worker-1"
+        assert strag["fastest"] == "worker-0"
+        rows = {r["node"]: r for r in strag["nodes"]}
+        assert rows["worker-1"]["p50_ms"] > rows["worker-0"]["p50_ms"]
+        assert rows["worker-0"]["rel"] == 1.0
+        cluster.shutdown(grace_secs=2)
+    finally:
+        engine.stop()
+        for k in (telemetry.NODE_ENV, telemetry.ROLE_ENV,
+                  telemetry.SPOOL_ENV):
+            os.environ.pop(k, None)
