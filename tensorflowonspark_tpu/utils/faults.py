@@ -83,6 +83,9 @@ SITES = (
     "serve.dispatch",       # serving/replicas.py, before routing a request
     "serve.resize",         # serving/elastic.py, before a pool resize
     "decode.step",          # serving/decode/scheduler.py engine loop body
+    "deploy.canary",        # workloads/deploy_loop.py, before opening canary
+    "deploy.promote",       # workloads/deploy_loop.py, before promote commit
+    "deploy.rollback",      # workloads/deploy_loop.py, before rollback commit
 )
 
 #: Sites whose hit counters live in long-lived executor processes, so a
@@ -98,6 +101,12 @@ CHAOS_SITES = ("engine.task", "node.boot", "feed.put", "rendezvous.query")
 #: fail the cohort and rebuild the caches — all recoverable, so a
 #: randomized plan over these must leave the pool serving.
 SERVE_CHAOS_SITES = ("serve.dispatch", "serve.resize", "decode.step")
+
+#: Deployment-loop counterpart: canary/promote/rollback faults raise in
+#: the promotion controller's decision path, which re-arms and retries on
+#: the next pump — recoverable by construction, so a randomized plan over
+#: these must leave the loop converging (and the pool serving).
+DEPLOY_CHAOS_SITES = ("deploy.canary", "deploy.promote", "deploy.rollback")
 
 
 class FaultInjected(RuntimeError):

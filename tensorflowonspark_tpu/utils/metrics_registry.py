@@ -186,6 +186,13 @@ CATALOG = {
         "counter", "Eval-sidecar evaluations completed."),
     "tfos_eval_last_step": (
         "gauge", "Checkpoint step of the last completed evaluation."),
+    # blessed-checkpoint deployment loop (utils/checkpoint.py manifests,
+    # serving/replicas.py canary arms, workloads/deploy_loop.py controller)
+    "tfos_deploy_blessed_step": (
+        "gauge", "Newest checkpoint step with a blessing manifest (the "
+                 "rollback target)."),
+    "tfos_deploy_tombstones_total": (
+        "counter", "Checkpoints quarantined by a rollback tombstone."),
     # SLO engine (obs/slo.py — driver process)
     "tfos_slo_burn_rate": (
         "gauge", "Error-budget burn rate per objective (1.0 spends the "

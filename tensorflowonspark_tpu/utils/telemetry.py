@@ -94,6 +94,10 @@ DECODE_RETIRE = "decode/retire"       # replica-side session retirement
 BENCH_REQUEST = "bench/request"       # loadgen per-request root span
 CLUSTER_RUN = "cluster/run"           # cluster root-trace anchor
 DATA_UNIT = "data/unit"               # one exactly-once data unit served
+DEPLOY_BLESS = "deploy/bless"         # checkpoint passed gate, manifest out
+DEPLOY_CANARY = "deploy/canary"       # canary arm opened on a candidate
+DEPLOY_PROMOTE = "deploy/promote"     # candidate promoted fleet-wide
+DEPLOY_ROLLBACK = "deploy/rollback"   # candidate rejected, blessed re-pinned
 
 
 # -- causal trace context (W3C-traceparent-shaped) -------------------------

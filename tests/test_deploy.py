@@ -1,0 +1,172 @@
+"""Blessed-checkpoint deployment loop (docs/deployment.md).
+
+Fast units for the integrity-manifest contract (bless / verify /
+tombstone), the hardened restore paths (truncated or quarantined newest
+step falls back to the previous one), canary routing, and the
+promote/rollback state machine; the slow lane holds the train → gate →
+canary → rollback e2e.  No reference counterpart — the reference stops
+at the TF Serving hand-off (SURVEY §1 L7).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.utils import checkpoint as ckpt
+from tensorflowonspark_tpu.utils import faults
+
+pytestmark = pytest.mark.deploy
+
+TREE = {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+        "b": np.zeros(3, np.float32)}
+
+
+def _save(d, step, scale=1.0):
+    return ckpt.save_checkpoint(
+        d, {"w": TREE["w"] * scale, "b": TREE["b"]}, step=step)
+
+
+# -- manifest write / verify / tombstone -------------------------------------
+
+def test_bless_writes_verifiable_manifest(tmp_path):
+    d = str(tmp_path / "ckpt")
+    _save(d, 5)
+    path = ckpt.bless_checkpoint(d, 5, score=0.42, eval_metrics={"loss": 0.42})
+    assert os.path.basename(path) == "bless-00000005.json"
+    with open(path, encoding="utf-8") as f:
+        manifest = json.load(f)
+    assert manifest["format"] == ckpt.MANIFEST_FORMAT
+    assert manifest["step"] == 5
+    assert manifest["score"] == pytest.approx(0.42)
+    assert manifest["eval"] == {"loss": 0.42}
+    assert manifest["tombstone"] is None
+    assert manifest["files"]["ckpt-00000005.npz"]["bytes"] > 0
+    ok, reason = ckpt.verify_manifest(d, 5)
+    assert ok, reason
+
+
+def test_bless_missing_step_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        ckpt.bless_checkpoint(str(tmp_path), 7)
+
+
+def test_verify_detects_corruption_and_absence(tmp_path):
+    d = str(tmp_path / "ckpt")
+    path = _save(d, 3)
+    assert ckpt.verify_manifest(d, 3) == (False, "unblessed")
+    ckpt.bless_checkpoint(d, 3)
+    # flip one byte: digest must catch silent corruption in place
+    with open(path, "r+b") as f:
+        f.seek(10)
+        b = f.read(1)
+        f.seek(10)
+        f.write(bytes([b[0] ^ 0xFF]))
+    ok, reason = ckpt.verify_manifest(d, 3)
+    assert not ok and "digest mismatch" in reason
+    os.remove(path)
+    ok, reason = ckpt.verify_manifest(d, 3)
+    assert not ok and "missing file" in reason
+
+
+def test_tombstone_quarantines(tmp_path):
+    d = str(tmp_path / "ckpt")
+    _save(d, 4)
+    ckpt.bless_checkpoint(d, 4)
+    assert ckpt.blessed_steps(d) == [4]
+    ckpt.tombstone_checkpoint(d, 4, reason="canary slo breach")
+    assert ckpt.blessed_steps(d) == []
+    ok, reason = ckpt.verify_manifest(d, 4)
+    assert not ok and "tombstoned" in reason
+    # tombstoning a never-blessed step creates the quarantine marker too
+    _save(d, 6)
+    ckpt.tombstone_checkpoint(d, 6, reason="eval regression")
+    assert not ckpt.verify_manifest(d, 6)[0]
+
+
+def test_latest_blessed_picks_newest_verifying(tmp_path):
+    d = str(tmp_path / "ckpt")
+    assert ckpt.latest_blessed(d) == (None, None)
+    _save(d, 2)
+    _save(d, 8)
+    ckpt.bless_checkpoint(d, 2)
+    ckpt.bless_checkpoint(d, 8)
+    step, path = ckpt.latest_blessed(d)
+    assert step == 8 and path.endswith("ckpt-00000008.npz")
+    ckpt.tombstone_checkpoint(d, 8, reason="bad")
+    assert ckpt.latest_blessed(d)[0] == 2
+
+
+# -- hardened restore: skip truncated / tombstoned, fall back a step ---------
+
+def test_restore_falls_back_past_truncated_newest(tmp_path):
+    d = str(tmp_path / "ckpt")
+    _save(d, 1, scale=1.0)
+    newest = _save(d, 2, scale=2.0)
+    # truncate the newest file: the torn-write case the manifest guards
+    size = os.path.getsize(newest)
+    with open(newest, "r+b") as f:
+        f.truncate(size // 2)
+    assert ckpt.latest_checkpoint(d).endswith("ckpt-00000001.npz")
+    tree, step = ckpt.restore_latest(d)
+    assert step == 1
+    np.testing.assert_allclose(tree["w"], TREE["w"])
+    tree, step = ckpt.restore_any(d)
+    assert step == 1
+
+
+def test_restore_skips_tombstoned_newest(tmp_path):
+    d = str(tmp_path / "ckpt")
+    _save(d, 1, scale=1.0)
+    _save(d, 2, scale=2.0)
+    ckpt.tombstone_checkpoint(d, 2, reason="rolled back")
+    tree, step = ckpt.restore_any(d)
+    assert step == 1
+    tree, step = ckpt.restore_latest(d)
+    assert step == 1
+    assert ckpt.latest_checkpoint(d).endswith("ckpt-00000001.npz")
+
+
+def test_restore_any_blessed_only(tmp_path):
+    d = str(tmp_path / "ckpt")
+    _save(d, 1, scale=1.0)
+    _save(d, 2, scale=2.0)
+    ckpt.bless_checkpoint(d, 1)
+    # serving contract: only blessed checkpoints may serve
+    tree, step = ckpt.restore_any(d, blessed_only=True)
+    assert step == 1
+    # trainer resume still takes the newer unblessed step
+    tree, step = ckpt.restore_any(d)
+    assert step == 2
+    np.testing.assert_allclose(tree["w"], TREE["w"] * 2)
+
+
+def test_restore_step_pinned(tmp_path):
+    d = str(tmp_path / "ckpt")
+    _save(d, 1, scale=1.0)
+    _save(d, 2, scale=2.0)
+    tree = ckpt.restore_step(d, 1)
+    np.testing.assert_allclose(tree["w"], TREE["w"])
+    with pytest.raises(FileNotFoundError):
+        ckpt.restore_step(d, 99)
+
+
+def test_digest_drift_skipped_on_restore(tmp_path):
+    """A blessed checkpoint whose bytes drifted after blessing must not
+    restore — the manifest is the arbiter, not mtime."""
+    d = str(tmp_path / "ckpt")
+    _save(d, 1, scale=1.0)
+    _save(d, 2, scale=2.0)
+    ckpt.bless_checkpoint(d, 2)
+    _save(d, 2, scale=3.0)  # rewrite after blessing: digest drift
+    tree, step = ckpt.restore_any(d)
+    assert step == 1
+
+
+# -- fault sites -------------------------------------------------------------
+
+def test_deploy_fault_sites_registered():
+    assert set(faults.DEPLOY_CHAOS_SITES) <= set(faults.SITES)
+    plan = faults.random_plan(7, sites=faults.DEPLOY_CHAOS_SITES)
+    assert any(s in plan for s in faults.DEPLOY_CHAOS_SITES)
