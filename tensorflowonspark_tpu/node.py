@@ -28,6 +28,7 @@ import os
 import signal
 import socket
 import sys
+import tempfile
 import threading
 import time
 import traceback
@@ -381,12 +382,22 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
         # Pin telemetry identity + node-local spool for this process AND
         # its fork children (trainer), via the env channel.  In-process
         # engines (sparkstub) may run this in the driver itself — never
-        # relabel the driver's recorder there.
+        # relabel the driver's recorder there.  The spool must live
+        # OUTSIDE the engine scratch cwd: engine.stop() rmtree's the
+        # scratch root, and flight dumps (*.json) are not part of the
+        # *.jsonl drain — a dump written moments before a crash has to
+        # survive engine teardown.  Non-dot dir name on purpose:
+        # postmortem's recursive glob skips dotdirs.
         if os.environ.get(telemetry.ROLE_ENV) != "driver":
+            base = os.environ.get(telemetry.DIR_ENV) or os.path.join(
+                tempfile.gettempdir(), ".tfos_telemetry")
+            cid = cluster_meta["id"] & 0xffffffff
             telemetry.configure(
                 node_id=f"{job_name}-{task_index}",
                 role=job_name,
-                spool=os.path.abspath(".tfos_telemetry"),
+                spool=os.path.join(
+                    os.path.abspath(base),
+                    f"spool-{cid:x}-{job_name}-{task_index}"),
             )
 
         faults.check("node.boot", executor=executor_id, job=job_name)

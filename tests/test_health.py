@@ -765,22 +765,20 @@ def test_e2e_nan_halt_checkpoints_last_finite_step(tmp_path, monkeypatch):
             cluster.shutdown(grace_secs=2)
         except (TaskError, RuntimeError, SystemExit):
             pass  # halted workers skipped the exit barrier: acceptable
-        # the flight recorder froze the ring at the anomaly; dumps live
-        # in the executors' spool dirs, which engine.stop() deletes with
-        # the scratch root (only *.jsonl is drained) — collect them now
-        dumps = []
-        for d in engine.executor_dirs:
-            # the spool is a dotdir, which "**" globs skip — name it
-            dumps += glob.glob(os.path.join(str(d), ".tfos_telemetry",
-                                            "flight-*.json"))
-        assert dumps, "no flight dump written on health/nan"
-        assert any(json.loads(open(p).read())["trigger"] == "health/nan"
-                   for p in dumps)
     finally:
         engine.stop()
         for k in (telemetry.NODE_ENV, telemetry.ROLE_ENV,
                   telemetry.SPOOL_ENV):
             os.environ.pop(k, None)
+
+    # the flight recorder froze the ring at the anomaly; dumps spool
+    # under $TFOS_TELEMETRY_DIR (NOT the engine scratch — deleted by
+    # engine.stop()), so they survive full teardown by construction
+    dumps = glob.glob(os.path.join(str(telemetry_dir), "spool-*",
+                                   "flight-*.json"))
+    assert dumps, "no flight dump survived engine stop on health/nan"
+    assert any(json.loads(open(p).read())["trigger"] == "health/nan"
+               for p in dumps)
 
     # nan@8 poisons the 8th recorded loss: both workers checkpointed at
     # the last finite step, 7 — deterministically

@@ -357,6 +357,46 @@ def test_telemetry_disabled_cluster_writes_nothing(tmp_path, monkeypatch):
     assert not (tmp_path / ".tfos_telemetry").exists()
 
 
+def _flight_dump_node_fn(args, ctx):
+    from tensorflowonspark_tpu.obs import flight
+    from tensorflowonspark_tpu.utils import telemetry as t
+
+    with t.span("user/work", task=ctx.task_index):
+        time.sleep(0.01)
+    assert flight.snapshot("test/manual", reason="spool survival probe")
+
+
+def test_flight_dump_survives_engine_stop(tmp_path):
+    """Regression (deploy-loop satellite): flight dumps used to spool
+    into a dotdir inside the engine scratch cwd, which engine.stop()
+    deletes — the black box died with the plane.  They must spool under
+    $TFOS_TELEMETRY_DIR and outlive full engine teardown."""
+    import glob as _glob
+
+    telemetry_dir = tmp_path / "telemetry"
+    os.environ[telemetry.DIR_ENV] = str(telemetry_dir)
+    engine = LocalEngine(2)
+    try:
+        cluster = TFCluster.run(
+            engine, _flight_dump_node_fn, [], num_executors=2,
+            input_mode=InputMode.TENSORFLOW,
+        )
+        cluster.shutdown()
+    finally:
+        engine.stop()
+    # engine scratch is gone; the dumps are not
+    dumps = _glob.glob(os.path.join(str(telemetry_dir), "spool-*",
+                                    "flight-*.json"))
+    assert dumps, "flight dump did not survive engine stop"
+    doc = json.loads(open(dumps[0], encoding="utf-8").read())
+    assert doc["trigger"] == "test/manual"
+    # and postmortem's recursive walk can see them (non-dot spool dirs)
+    from tensorflowonspark_tpu.obs import postmortem
+
+    found = postmortem.load_dumps(str(telemetry_dir))
+    assert found, "postmortem walk missed the surviving dump"
+
+
 # --- trace merge ------------------------------------------------------------
 
 def _synthesize(tmp_path):
