@@ -233,6 +233,12 @@ class Batch:
                 "batch": self.n_valid,
                 "bucket": self.bucket,
             }
+            # replica-reported provenance: which params version answered
+            # (canary rollouts split SLO telemetry by this, docs/deployment.md)
+            if "version" in meta:
+                attrs["version"] = meta["version"]
+            if "replica" in meta:
+                attrs["replica"] = meta["replica"]
             if self._observer is not None:
                 try:
                     self._observer(attrs)

@@ -289,8 +289,7 @@ class ElasticReplicaPool(ReplicaPool):
         if kind == "params_sync":
             _, idx, version, blob = msg
             with self._el_lock:
-                if self._mirror_version is None \
-                        or version >= self._mirror_version:
+                if self._accept_mirror(version):
                     self._mirror_version, self._mirror_blob = version, blob
             return True
         if kind == "resized":
@@ -322,6 +321,23 @@ class ElasticReplicaPool(ReplicaPool):
                 self._resized_for = None  # next tick re-resizes (new gen)
             return True
         return False
+
+    def _accept_mirror(self, version):
+        """Should a ``params_sync`` at ``version`` replace the adopt
+        mirror?  Latest-wins — UNLESS a promotion watermark is set
+        (ROADMAP item 6 follow-on): mid-canary the canary arm syncs the
+        unblessed candidate, and a replica regrown from the mirror must
+        adopt the *blessed* version, not the candidate.  With a
+        watermark W: prefer the newest version <= W; a version > W is
+        taken only when the mirror is empty (candidate params beat no
+        params) or the mirror itself is already past W."""
+        wm = self.watermark()
+        cur = self._mirror_version
+        if wm is None:
+            return cur is None or version >= cur
+        if version <= wm:
+            return cur is None or cur > wm or version >= cur
+        return cur is None or (cur > wm and version >= cur)
 
     def _tick(self):
         self._maybe_resize("membership changed")

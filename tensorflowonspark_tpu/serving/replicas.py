@@ -28,6 +28,19 @@ thread polls ``utils/checkpoint.latest`` every
 to every replica.  In-band means ordered behind already-queued batches:
 in-flight requests finish on the old params, later ones see the new —
 no drop, no lock.
+
+Canary routing (docs/deployment.md): ``set_canary`` pins a subset of
+replicas at a candidate version (in-band ``("reload", step)`` — pinned
+reloads may go DOWN-version, unlike the latest-wins watcher) and splits
+dispatch deterministically by request id: ~pct% of traffic lands on the
+canary arm, the rest on the baseline, least-loaded within the arm.  Arm
+outcomes accumulate into ``tfos_deploy_*`` metrics and ``canary_stats``
+for the promotion controller's burn-window verdict; ``promote_canary``
+reloads the baseline at the candidate and advances the watermark,
+``rollback_canary`` re-pins the canary arm at the blessed watermark.
+While a watermark is set the latest-wins watcher stands down (the
+controller owns version transitions) and a respawned replica that cold-
+booted at the wrong version is steered back to its arm's pin.
 """
 
 from __future__ import annotations
@@ -249,21 +262,42 @@ def _resolve_predictor(payload):
     return pred
 
 
-def _maybe_reload(pred, ckpt_dir):
-    """Swap in the newest checkpoint if it is newer than ``pred.version``;
-    returns True when params changed."""
+def _maybe_reload(pred, ckpt_dir, step=None):
+    """Swap in new params; returns True when they changed.
+
+    ``step=None``: the newest checkpoint, if newer than ``pred.version``
+    (the latest-wins watcher path).  ``step=N``: that step EXACTLY —
+    pinned reloads serve the canary candidate and the rollback target,
+    and may go down-version by design."""
     from tensorflowonspark_tpu.utils import checkpoint as ckpt
 
+    if step is not None:
+        step = int(step)
+        if step == pred.version:
+            return False
+        pred.params = ckpt.restore_step(ckpt_dir, step)
+        pred.version = step
+        logger.info("replica pinned params at step %d", step)
+        return True
     step, _path = ckpt.latest(ckpt_dir)
     if step is None or step == pred.version:
         return False
     tree, step = ckpt.restore_any(ckpt_dir)
-    if tree is None:
+    if tree is None or step == pred.version:
         return False
     pred.params = tree
     pred.version = step
     logger.info("replica reloaded params at step %d", step)
     return True
+
+
+def canary_arm(route_id, pct):
+    """True when ``route_id`` hashes into the canary arm.  Deterministic
+    (same id, same arm — across processes and retries) with 1% split
+    granularity; zlib.crc32 so the split needs no seeding."""
+    import zlib
+
+    return (zlib.crc32(str(route_id).encode()) % 100) < float(pct)
 
 
 def _make_replica_task(payload_blob, mgr_addr, mgr_authkey):
@@ -339,9 +373,13 @@ def _make_replica_task(payload_blob, mgr_addr, mgr_authkey):
                 if kind == "stop":
                     break
                 if kind == "reload":
+                    # bare ("reload",) = latest-wins; ("reload", step) =
+                    # pinned (canary candidate / rollback target)
+                    pin = msg[1] if len(msg) > 1 else None
                     try:
                         if payload.get("ckpt_dir") \
-                                and _maybe_reload(pred, payload["ckpt_dir"]):
+                                and _maybe_reload(pred, payload["ckpt_dir"],
+                                                  step=pin):
                             if engine is not None:
                                 engine.set_params(pred.params)
                             if elastic_cfg:
@@ -440,6 +478,12 @@ class ReplicaPool:
         # namespaced ("batch", id) / ("gen", sid)
         self._table = InFlightTable(self.num_replicas)
         self._versions = {}          # idx -> last acked params version
+        # staged-rollout state (all under self._lock): the open canary
+        # split, the blessed watermark, and bounded per-arm outcome
+        # accumulators for the controller's burn-window verdict
+        self._canary = None          # {"replicas", "version", "pct"}
+        self._watermark = None       # blessed step the pool is pinned to
+        self._arm_stats = None       # arm -> {"n", "errors", "ms": [...]}
         self._stats_replies = {}
         self._stats_event = threading.Event()
         self._registered = threading.Event()
@@ -539,8 +583,8 @@ class ReplicaPool:
             raise RuntimeError(
                 f"no replicas left (job failed: {self._job_error})")
         blob = cloudpickle.dumps((batch.inputs, batch.n_valid))
-        idx = self._table.add(("batch", batch.id),
-                              {"batch": batch, "blob": blob})
+        idx = self._route(("batch", batch.id),
+                          {"batch": batch, "blob": blob}, batch.id)
         self._inqs[idx].put(("batch", batch.id, blob))
 
     def dispatch_session(self, session):
@@ -568,8 +612,8 @@ class ReplicaPool:
             # joins the originating request's trace tree
             "trace": getattr(session, "trace", None),
         })
-        idx = self._table.add(("gen", session.id),
-                              {"session": session, "blob": blob})
+        idx = self._route(("gen", session.id),
+                          {"session": session, "blob": blob}, session.id)
         self._inqs[idx].put(("gen", session.id, blob))
 
     def cancel_session(self, sid):
@@ -579,6 +623,172 @@ class ReplicaPool:
 
     def outstanding_sessions(self):
         return sum(1 for k in self._table.keys() if k[0] == "gen")
+
+    def _route(self, key, entry, route_id):
+        """Owner pick: least-loaded overall, or — with a canary open —
+        least-loaded within the arm ``route_id`` hashes into.  An arm
+        with no live member degrades to any live replica (a routing
+        split must never drop a request)."""
+        with self._lock:
+            canary = self._canary
+        if canary is None:
+            return self._table.add(key, entry)
+        arm = "canary" if canary_arm(route_id, canary["pct"]) else "baseline"
+        live = self._table.live()
+        if arm == "canary":
+            cands = [i for i in live if i in canary["replicas"]]
+        else:
+            cands = [i for i in live if i not in canary["replicas"]]
+        entry["arm"] = arm
+        if not cands:
+            return self._table.add(key, entry)
+        loads = self._table.loads()
+        owner = min(cands, key=lambda i: (loads.get(i, 0), i))
+        return self._table.add(key, entry, owner=owner)
+
+    def _account(self, entry, ok):
+        """Per-arm outcome accounting for a resolved entry dispatched
+        under a canary split (no-op otherwise): feeds the
+        ``tfos_deploy_*`` metrics and the bounded in-memory stats the
+        promotion controller reads via :meth:`canary_stats`."""
+        arm = entry.get("arm")
+        if arm is None:
+            return
+        ms = (time.monotonic() - entry["t"]) * 1e3
+        metrics_registry.inc("tfos_deploy_requests_total", arm=arm,
+                             status="ok" if ok else "error")
+        metrics_registry.observe("tfos_deploy_request_ms", ms, arm=arm)
+        with self._lock:
+            if self._arm_stats is None:
+                return
+            st = self._arm_stats.get(arm)
+            if st is None:
+                return
+            st["n"] += 1
+            if not ok:
+                st["errors"] += 1
+            st["ms"].append(ms)
+            del st["ms"][:-512]  # bounded: enough for burn-window p95
+
+    # -- canary / staged rollout ----------------------------------------------
+    def set_watermark(self, step):
+        """Pin the blessed version.  While set, the latest-wins reload
+        watcher stands down (the promotion controller owns version
+        transitions) and freshly-up replicas are steered to their arm's
+        pin (:meth:`_enforce_version`).  ``None`` releases the pin."""
+        with self._lock:
+            self._watermark = None if step is None else int(step)
+
+    def watermark(self):
+        with self._lock:
+            return self._watermark
+
+    def set_canary(self, replicas, version, pct):
+        """Open a canary: pin ``replicas`` at candidate ``version`` (in-
+        band pinned reload) and route ~``pct``% of traffic to them.
+        The arm must leave at least one baseline replica."""
+        arm = tuple(sorted(int(i) for i in replicas))
+        live = self._table.live()
+        if not arm or not set(arm) <= set(live):
+            raise ValueError(f"canary replicas {arm} not all live ({live})")
+        if len(arm) >= len(live):
+            raise ValueError("canary arm must leave a baseline replica")
+        version = int(version)
+        with self._lock:
+            self._canary = {"replicas": arm, "version": version,
+                            "pct": float(pct)}
+            self._arm_stats = {
+                "canary": {"n": 0, "errors": 0, "ms": []},
+                "baseline": {"n": 0, "errors": 0, "ms": []},
+            }
+        for idx in arm:
+            self._inqs[idx].put(("reload", version))
+        metrics_registry.set_gauge("tfos_deploy_canary_step", version)
+        telemetry.event(telemetry.DEPLOY_CANARY, version=version,
+                        replicas=list(arm), pct=float(pct))
+        logger.info("canary open: replicas %s at step %d (%s%% traffic)",
+                    arm, version, pct)
+        return arm
+
+    def promote_canary(self):
+        """Candidate wins: reload the baseline at the candidate version,
+        advance the watermark, clear the split.  Returns the promoted
+        step."""
+        with self._lock:
+            canary = self._canary
+        if canary is None:
+            raise RuntimeError("promote_canary: no canary open")
+        version = canary["version"]
+        for idx in self._table.live():
+            if idx not in canary["replicas"]:
+                self._inqs[idx].put(("reload", version))
+        with self._lock:
+            self._watermark = version
+            self._canary = None
+        logger.info("canary promoted: pool pinned at step %d", version)
+        return version
+
+    def rollback_canary(self, step=None):
+        """Candidate loses: re-pin the canary arm at the blessed
+        watermark (or an explicit ``step``), clear the split.  Returns
+        the step rolled back to."""
+        with self._lock:
+            canary = self._canary
+            target = self._watermark if step is None else int(step)
+        if canary is None:
+            raise RuntimeError("rollback_canary: no canary open")
+        if target is None:
+            raise RuntimeError("rollback_canary: no watermark to re-pin")
+        for idx in canary["replicas"]:
+            self._inqs[idx].put(("reload", target))
+        with self._lock:
+            self._watermark = target
+            self._canary = None
+        logger.info("canary rolled back: arm %s re-pinned at step %d",
+                    canary["replicas"], target)
+        return target
+
+    def canary(self):
+        """The open split ({"replicas", "version", "pct"}) or None."""
+        with self._lock:
+            return dict(self._canary) if self._canary else None
+
+    def canary_stats(self):
+        """Per-arm outcome snapshot since the split opened:
+        ``{arm: {"n", "errors", "p50_ms", "p95_ms"}}`` — the burn-window
+        evidence the promotion controller judges."""
+        with self._lock:
+            stats = self._arm_stats
+            out = {}
+            if stats is None:
+                return out
+            for arm, st in stats.items():
+                ms = sorted(st["ms"])
+                out[arm] = {
+                    "n": st["n"],
+                    "errors": st["errors"],
+                    "p50_ms": ms[len(ms) // 2] if ms else None,
+                    "p95_ms": ms[int(len(ms) * 0.95)] if ms else None,
+                }
+            return out
+
+    def _enforce_version(self, idx, version):
+        """Respawn-mid-rollout convergence: a replica that just came up
+        cold-booted at the NEWEST checkpoint, which mid-canary may be
+        the unblessed candidate.  Steer it to its arm's pinned version
+        with a targeted in-band reload."""
+        with self._lock:
+            canary, wm = self._canary, self._watermark
+        if canary is not None and idx in canary["replicas"]:
+            want = canary["version"]
+        else:
+            want = wm
+        if want is None or version == want:
+            return
+        try:
+            self._inqs[idx].put(("reload", want))
+        except Exception:  # noqa: BLE001 - manager tearing down
+            pass
 
     # -- background threads ----------------------------------------------------
     def _collect(self):
@@ -603,6 +813,7 @@ class ReplicaPool:
                 self._registered.set()
                 telemetry.event("serve/replica_up", replica=idx, pid=pid,
                                 version=version)
+                self._enforce_version(idx, version)
                 if respawned:
                     # A respawn can beat the monitor's death-detection
                     # poll, so this is the authoritative failover trigger:
@@ -623,14 +834,17 @@ class ReplicaPool:
                 try:
                     outputs = cloudpickle.loads(payload)
                     entry["batch"].complete(outputs, meta)
+                    self._account(entry, ok=True)
                 except Exception as e:  # noqa: BLE001
                     entry["batch"].fail(e)
+                    self._account(entry, ok=False)
             elif kind == "batch_error":
                 _, idx, batch_id, tb = msg
                 entry = self._table.pop(("batch", batch_id))
                 if entry is not None:
                     entry["batch"].fail(RuntimeError(
                         f"replica {idx} failed the batch:\n{tb}"))
+                    self._account(entry, ok=False)
             elif kind == "gen_token":
                 _, idx, sid, tindex, tok = msg
                 # touch: a streamed token proves the stream is alive
@@ -643,12 +857,14 @@ class ReplicaPool:
                 if entry is None:
                     continue  # duplicate answer after a re-dispatch
                 entry["session"]._set(tokens, meta)
+                self._account(entry, ok=True)
             elif kind == "gen_error":
                 _, idx, sid, err = msg
                 entry = self._table.pop(("gen", sid))
                 if entry is not None:
                     entry["session"]._fail(RuntimeError(
                         f"replica {idx} failed the decode session: {err}"))
+                    self._account(entry, ok=False)
             elif kind == "reloaded":
                 with self._lock:
                     self._versions[msg[1]] = msg[2]
@@ -705,6 +921,7 @@ class ReplicaPool:
                     entry["session"]._fail(TimeoutError(
                         "decode session streamed no token within "
                         f"{self._request_timeout}s"))
+                self._account(entry, ok=False)
 
     def _redispatch(self, dead_idxs):
         """Re-send a dead replica's in-flight work to survivors.  Decode
@@ -777,6 +994,13 @@ class ReplicaPool:
             last = max(self._versions.values(), default=0)
         interval = reload_secs_default()
         while not self._stop.wait(interval):
+            with self._lock:
+                managed = (self._watermark is not None
+                           or self._canary is not None)
+            if managed:
+                # a promotion controller owns version transitions:
+                # latest-wins broadcasts would race the pinned arms
+                continue
             try:
                 step, _path = ckpt.latest(self.spec.ckpt_dir)
             except Exception:  # noqa: BLE001 - transient fs error

@@ -235,12 +235,19 @@ class Server:
         self.stats.observe_request(attrs)
         metrics_registry.inc("tfos_serve_requests_total", status="ok")
         metrics_registry.observe("tfos_serve_request_ms", attrs["total_ms"])
-        telemetry.record_span(
-            telemetry.SERVE_REQUEST, attrs["total_ms"] / 1e3,
+        span_attrs = dict(
             queue_ms=round(attrs["queue_ms"], 3),
             batch_ms=round(attrs["batch_ms"], 3),
             device_ms=round(attrs["device_ms"], 3),
             batch=attrs["batch"], bucket=attrs["bucket"])
+        # version-tagged spans: trace_merge and /statusz split request
+        # telemetry by the params version that answered (canary rollouts)
+        if "version" in attrs:
+            span_attrs["version"] = attrs["version"]
+        if "replica" in attrs:
+            span_attrs["replica"] = attrs["replica"]
+        telemetry.record_span(
+            telemetry.SERVE_REQUEST, attrs["total_ms"] / 1e3, **span_attrs)
 
     def _on_batch(self, batch, meta):
         self.stats.observe_batch(batch, meta)

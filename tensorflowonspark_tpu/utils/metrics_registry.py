@@ -193,6 +193,14 @@ CATALOG = {
                  "rollback target)."),
     "tfos_deploy_tombstones_total": (
         "counter", "Checkpoints quarantined by a rollback tombstone."),
+    "tfos_deploy_canary_step": (
+        "gauge", "Candidate checkpoint step the open canary arm serves."),
+    "tfos_deploy_requests_total": (
+        "counter", "Requests resolved under a canary split, by arm "
+                   "(canary|baseline) and status (ok|error)."),
+    "tfos_deploy_request_ms": (
+        "histogram", "End-to-end request latency under a canary split, "
+                     "by arm."),
     # SLO engine (obs/slo.py — driver process)
     "tfos_slo_burn_rate": (
         "gauge", "Error-budget burn rate per objective (1.0 spends the "

@@ -10,6 +10,8 @@ at the TF Serving hand-off (SURVEY §1 L7).
 
 import json
 import os
+import threading
+import time
 
 import numpy as np
 import pytest
@@ -18,6 +20,13 @@ from tensorflowonspark_tpu.utils import checkpoint as ckpt
 from tensorflowonspark_tpu.utils import faults
 
 pytestmark = pytest.mark.deploy
+
+
+def _serve_version(params, inputs):
+    """Module-level probe predict (cloudpickled into replica procs)."""
+    x = np.asarray(inputs["x"])
+    return {"version": np.full(x.shape[0],
+                               float(np.asarray(params["version"])))}
 
 TREE = {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
         "b": np.zeros(3, np.float32)}
@@ -170,3 +179,178 @@ def test_deploy_fault_sites_registered():
     assert set(faults.DEPLOY_CHAOS_SITES) <= set(faults.SITES)
     plan = faults.random_plan(7, sites=faults.DEPLOY_CHAOS_SITES)
     assert any(s in plan for s in faults.DEPLOY_CHAOS_SITES)
+
+
+# -- canary routing (serving/replicas.py) ------------------------------------
+
+def test_canary_arm_split_deterministic():
+    from tensorflowonspark_tpu.serving import replicas as R
+
+    ids = list(range(2000))
+    arms = [R.canary_arm(i, 10) for i in ids]
+    # deterministic: the same id always lands on the same arm
+    assert arms == [R.canary_arm(i, 10) for i in ids]
+    frac = sum(arms) / len(arms)
+    assert 0.05 < frac < 0.15  # ~10% with crc32 uniformity slack
+    assert not any(R.canary_arm(i, 0) for i in ids)
+    assert all(R.canary_arm(i, 100) for i in ids)
+    # string and int ids hash identically (route ids cross IPC as either)
+    assert R.canary_arm(42, 37) == R.canary_arm("42", 37)
+
+
+def _bare_pool(live_idxs):
+    """A ReplicaPool skeleton with just the routing state: enough to
+    unit-test `_route` without spinning up an engine job."""
+    from tensorflowonspark_tpu.actors.dispatch import InFlightTable
+    from tensorflowonspark_tpu.serving import replicas as R
+
+    pool = R.ReplicaPool.__new__(R.ReplicaPool)
+    pool._lock = threading.Lock()
+    pool._table = InFlightTable(max(live_idxs) + 1)
+    for i in live_idxs:
+        pool._table.up(i, 1000 + i)
+    pool._canary = None
+    pool._watermark = None
+    pool._arm_stats = None
+    return pool
+
+
+def test_route_restricts_to_arm():
+    pool = _bare_pool([0, 1, 2])
+    pool._canary = {"replicas": (2,), "version": 9, "pct": 100.0}
+    for rid in range(8):  # pct=100: every route id is canary
+        entry = {"t": time.monotonic()}
+        idx = pool._route(("batch", rid), entry, rid)
+        assert idx == 2 and entry["arm"] == "canary"
+    pool2 = _bare_pool([0, 1, 2])
+    pool2._canary = {"replicas": (2,), "version": 9, "pct": 0.0}
+    owners = set()
+    for rid in range(8):  # pct=0: everything stays on the baseline
+        entry = {"t": time.monotonic()}
+        owners.add(pool2._route(("batch", rid), entry, rid))
+        assert entry["arm"] == "baseline"
+    assert owners <= {0, 1}
+    # least-loaded inside the arm: 8 requests spread across 2 replicas
+    assert owners == {0, 1}
+
+
+def test_route_empty_arm_degrades_not_drops():
+    pool = _bare_pool([0, 1])
+    # the whole canary arm died: requests hashed to it must still land
+    pool._canary = {"replicas": (7,), "version": 9, "pct": 100.0}
+    for rid in range(4):
+        idx = pool._route(("batch", rid), {"t": time.monotonic()}, rid)
+        assert idx in (0, 1)
+
+
+def test_accept_mirror_watermark_rule():
+    from tensorflowonspark_tpu.serving import elastic as E
+
+    def accept(watermark, mirror, version):
+        pool = E.ElasticReplicaPool.__new__(E.ElasticReplicaPool)
+        pool._lock = threading.Lock()
+        pool._watermark = watermark
+        pool._mirror_version = mirror
+        return pool._accept_mirror(version)
+
+    # no watermark: plain latest-wins
+    assert accept(None, None, 5)
+    assert accept(None, 3, 5)
+    assert not accept(None, 5, 3)
+    # watermark 10: blessed-side syncs are latest-wins up to the mark
+    assert accept(10, None, 8)
+    assert accept(10, 6, 8)
+    assert not accept(10, 8, 6)
+    # the unblessed candidate (12 > wm) must NOT displace a blessed
+    # mirror — a regrown replica adopts the blessed params
+    assert not accept(10, 8, 12)
+    # ...unless there is nothing blessed to keep (empty mirror), or the
+    # mirror is already past the mark
+    assert accept(10, None, 12)
+    assert accept(10, 12, 14)
+    # a blessed sync pulls a candidate-tainted mirror back under the mark
+    assert accept(10, 12, 8)
+
+
+# -- staged rollout end-to-end against a live pool ---------------------------
+
+def _wait_versions(pool, want, timeout=30):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pool.versions() == want:
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"versions {pool.versions()} never became {want}")
+
+
+def test_canary_promote_and_rollback_live_pool(tmp_path, monkeypatch):
+    """Staged rollout against a real 3-replica pool: watermark pin
+    suppresses latest-wins, a pinned canary serves the candidate to
+    100% of hashed traffic, rollback re-pins the arm at the blessed
+    step, and promotion converges the whole pool."""
+    from tensorflowonspark_tpu.serving import replicas as R
+    from tensorflowonspark_tpu.serving import server as S
+
+    d = str(tmp_path / "ckpt")
+    ckpt.save_checkpoint(d, {"version": np.array(1.0)}, step=1)
+    monkeypatch.setenv("TFOS_SERVE_RELOAD_SECS", "0.2")
+    spec = R.ModelSpec(predict=_serve_version, ckpt_dir=d, jit=False)
+    with S.Server(spec, num_replicas=3, max_batch=8, max_delay_ms=5) as srv:
+        pool = srv.pool
+        c = srv.client()
+        assert set(pool.versions().values()) == {1}
+        pool.set_watermark(1)
+        ckpt.save_checkpoint(d, {"version": np.array(2.0)}, step=2)
+        # watermark pins the pool: the latest-wins watcher stands down
+        time.sleep(0.8)
+        assert set(pool.versions().values()) == {1}
+
+        arm = pool.set_canary([0], version=2, pct=100)
+        assert arm == (0,)
+        _wait_versions(pool, {0: 2, 1: 1, 2: 1})
+        got = [float(c.predict({"x": np.ones(1, np.float32)},
+                               timeout=60)["version"])
+               for _ in range(6)]
+        assert got == [2.0] * 6  # pct=100: every request hits the canary
+        stats = pool.canary_stats()
+        assert stats["canary"]["n"] >= 1 and stats["canary"]["errors"] == 0
+        assert stats["canary"]["p50_ms"] is not None
+        assert stats["baseline"]["n"] == 0
+
+        # candidate loses: the arm re-pins at the blessed watermark
+        assert pool.rollback_canary() == 1
+        assert pool.canary() is None and pool.watermark() == 1
+        _wait_versions(pool, {0: 1, 1: 1, 2: 1})
+        got = [float(c.predict({"x": np.ones(1, np.float32)},
+                               timeout=60)["version"])
+               for _ in range(4)]
+        assert got == [1.0] * 4
+
+        # second attempt wins: promotion converges the whole pool
+        pool.set_canary([1], version=2, pct=0)
+        _wait_versions(pool, {0: 1, 1: 2, 2: 1})
+        got = [float(c.predict({"x": np.ones(1, np.float32)},
+                               timeout=60)["version"])
+               for _ in range(4)]
+        assert got == [1.0] * 4  # pct=0: traffic stays on the baseline
+        assert pool.canary_stats()["baseline"]["n"] >= 1
+        assert pool.promote_canary() == 2
+        assert pool.watermark() == 2 and pool.canary() is None
+        _wait_versions(pool, {0: 2, 1: 2, 2: 2})
+        got = [float(c.predict({"x": np.ones(1, np.float32)},
+                               timeout=60)["version"])
+               for _ in range(4)]
+        assert got == [2.0] * 4
+
+
+def test_set_canary_validates_arm(tmp_path):
+    pool = _bare_pool([0, 1])
+    pool._inqs = {}
+    with pytest.raises(ValueError):
+        pool.set_canary([5], version=2, pct=10)  # not live
+    with pytest.raises(ValueError):
+        pool.set_canary([0, 1], version=2, pct=10)  # no baseline left
+    with pytest.raises(RuntimeError):
+        pool.promote_canary()  # nothing open
+    with pytest.raises(RuntimeError):
+        pool.rollback_canary()
