@@ -499,6 +499,18 @@ class ObsServer:
             pools = []
         if pools:
             out["pools"] = pools
+        # Blessed-checkpoint deployment loops: rollout state, watermark,
+        # per-arm canary evidence (same lazy pattern as actors/pools).
+        try:
+            from tensorflowonspark_tpu.workloads.deploy_loop import (
+                deploy_table,
+            )
+
+            deploys = deploy_table()
+        except Exception:  # noqa: BLE001 - loops tearing down
+            deploys = []
+        if deploys:
+            out["deploy"] = deploys
         return out
 
     def render_slo(self):

@@ -183,6 +183,42 @@ def render_health(status):
     return "\n".join(lines) + "\n"
 
 
+def render_deploy(status):
+    """The --deploy pane text: per-loop rollout state from the
+    ``/statusz`` deploy section (workloads/deploy_loop.py,
+    docs/deployment.md)."""
+    lines = ["", "deploy (workloads/deploy_loop.py):"]
+    rows = status.get("deploy") or []
+    if not rows:
+        lines.append("  (no deployment loops)")
+        return "\n".join(lines) + "\n"
+    for row in rows:
+        canary = row.get("canary") or {}
+        head = (f"  {row.get('ckpt_dir', '?')}: {row.get('state', '?')} "
+                f"wm={row.get('watermark', '-')} "
+                f"cand={row.get('candidate', '-')} "
+                f"promoted={row.get('promotions', 0)} "
+                f"rolled_back={row.get('rollbacks', 0)}")
+        if canary:
+            head += (f" arm={canary.get('replicas')}"
+                     f"@{canary.get('pct', '?')}%")
+        if row.get("burn_remaining_s") is not None:
+            head += f" burn={row['burn_remaining_s']}s"
+        lines.append(head)
+        for arm, st in sorted((row.get("stats") or {}).items()):
+            lines.append(
+                f"    {arm}: n={st.get('n', 0)} "
+                f"errors={st.get('errors', 0)} "
+                f"p50={_num(st.get('p50_ms'))}ms "
+                f"p95={_num(st.get('p95_ms'))}ms")
+        last = row.get("last_verdict")
+        if last:
+            why = "; ".join(last.get("reasons") or []) or "clean"
+            lines.append(f"    last: {last.get('verdict', '?')} "
+                         f"step={last.get('step', '?')} ({why})")
+    return "\n".join(lines) + "\n"
+
+
 def fetch_statusz(url, timeout=5):
     """GET <url>/statusz and parse it; raises URLError/ValueError."""
     with urllib.request.urlopen(url.rstrip("/") + "/statusz",
@@ -230,6 +266,9 @@ def build_parser():
                    help="append the SLO pane (objective, current, burn)")
     p.add_argument("--health", action="store_true",
                    help="append the health pane (anomalies, stragglers)")
+    p.add_argument("--deploy", action="store_true",
+                   help="append the deploy pane (rollout state, canary "
+                        "arms, verdicts)")
     return p
 
 
@@ -258,6 +297,8 @@ def main(argv=None, out=None):
             text += render_slo(status)
         if args.health:
             text += render_health(status)
+        if args.deploy:
+            text += render_deploy(status)
         if args.once:
             out.write(text)
             out.flush()

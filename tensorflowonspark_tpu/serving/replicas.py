@@ -748,6 +748,18 @@ class ReplicaPool:
                     canary["replicas"], target)
         return target
 
+    def pin_version(self, step):
+        """Pin the WHOLE pool at blessed ``step``: targeted reloads on
+        every live replica + the watermark.  The bootstrap promotion
+        path (first blessed checkpoint, no baseline to canary against)
+        and the recovery path (driver restart re-pins from the newest
+        blessed manifest) both land here."""
+        step = int(step)
+        for idx in self._table.live():
+            self._inqs[idx].put(("reload", step))
+        self.set_watermark(step)
+        return step
+
     def canary(self):
         """The open split ({"replicas", "version", "pct"}) or None."""
         with self._lock:
@@ -771,6 +783,40 @@ class ReplicaPool:
                     "p95_ms": ms[int(len(ms) * 0.95)] if ms else None,
                 }
             return out
+
+    def canary_snapshot(self):
+        """The split's per-arm outcomes as a registry-shaped snapshot
+        (``{metric: {"type", "series": [...]}}``) — the exact input
+        ``obs/slo.evaluate`` consumes, so the promotion controller
+        judges the burn window with the same SLO math as the live
+        metrics plane.  The bounded ms samples are bucketed onto the
+        default histogram bounds; empty without an open split."""
+        bounds = list(metrics_registry.DEFAULT_BUCKETS_MS)
+        counters, hists = [], []
+        with self._lock:
+            stats = self._arm_stats
+            if stats is None:
+                return {}
+            for arm, st in sorted(stats.items()):
+                counters.append({"labels": {"arm": arm, "status": "ok"},
+                                 "value": float(st["n"] - st["errors"])})
+                counters.append({"labels": {"arm": arm, "status": "error"},
+                                 "value": float(st["errors"])})
+                counts = [0] * (len(bounds) + 1)
+                for v in st["ms"]:
+                    for i, b in enumerate(bounds):
+                        if v <= b:
+                            counts[i] += 1
+                            break
+                    else:
+                        counts[-1] += 1
+                hists.append({"labels": {"arm": arm}, "bounds": bounds,
+                              "counts": counts, "sum": float(sum(st["ms"])),
+                              "count": len(st["ms"])})
+        return {"tfos_deploy_requests_total": {"type": "counter",
+                                               "series": counters},
+                "tfos_deploy_request_ms": {"type": "histogram",
+                                           "series": hists}}
 
     def _enforce_version(self, idx, version):
         """Respawn-mid-rollout convergence: a replica that just came up

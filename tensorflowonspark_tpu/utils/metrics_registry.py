@@ -201,6 +201,11 @@ CATALOG = {
     "tfos_deploy_request_ms": (
         "histogram", "End-to-end request latency under a canary split, "
                      "by arm."),
+    "tfos_deploy_promotions_total": (
+        "counter", "Canary candidates promoted to the full pool "
+                   "(bootstrap pins included)."),
+    "tfos_deploy_rollbacks_total": (
+        "counter", "Canary candidates auto-rolled back and tombstoned."),
     # SLO engine (obs/slo.py — driver process)
     "tfos_slo_burn_rate": (
         "gauge", "Error-budget burn rate per objective (1.0 spends the "

@@ -6,6 +6,9 @@ supervision/respawn/ledger code (the lint test in tests/test_actors.py
 enforces this for everything outside ``actors/``).
 """
 
+from tensorflowonspark_tpu.workloads.deploy_loop import (  # noqa: F401
+    DeployLoop, PromotionController, deploy_table, run_deploy_loop,
+)
 from tensorflowonspark_tpu.workloads.eval_sidecar import (  # noqa: F401
     EvalSidecar,
 )

@@ -354,3 +354,311 @@ def test_set_canary_validates_arm(tmp_path):
         pool.promote_canary()  # nothing open
     with pytest.raises(RuntimeError):
         pool.rollback_canary()
+
+
+# -- promotion gate (workloads/deploy_loop.py PromotionController) -----------
+
+class _FakeLedger:
+    def __init__(self):
+        self.seen = set()
+
+    def done(self, feed, unit):
+        return (feed, unit) in self.seen
+
+    def record(self, feed, unit):
+        if (feed, unit) in self.seen:
+            return False
+        self.seen.add((feed, unit))
+        return True
+
+    def done_units(self, feed):
+        return sorted(u for f, u in self.seen if f == feed)
+
+
+class _FakeMgr:
+    def __init__(self):
+        self.kv = {}
+
+    def get(self, key):
+        return self.kv.get(key)
+
+    def set(self, key, value):
+        self.kv[key] = value
+
+
+class _FakeCtx:
+    """Just enough ActorContext for PromotionController.on_tick."""
+
+    def __init__(self, group="deploy"):
+        self.group = group
+        self.ledger = _FakeLedger()
+        self.mgr = _FakeMgr()
+        self.events = []
+
+    def kv_set(self, key, value):
+        self.mgr.set(f"actor_kv:{self.group}:{key}", value)
+
+    def emit(self, kind, payload=None):
+        self.events.append((kind, payload))
+
+
+def _eval_result(ctx, step, metrics):
+    ctx.mgr.set(f"actor_kv:eval:eval_result:{step}",
+                {"step": step, "metrics": metrics})
+
+
+def test_controller_blesses_passing_step_once(tmp_path):
+    from tensorflowonspark_tpu.workloads.deploy_loop import (
+        PromotionController,
+    )
+
+    d = str(tmp_path / "ckpt")
+    _save(d, 1)
+    ctrl = PromotionController(d, eval_group="eval")
+    ctx = _FakeCtx()
+    ctrl.on_tick(ctx)  # no eval result yet: waits
+    assert ckpt.read_manifest(d, 1) is None
+    _eval_result(ctx, 1, {"loss": 0.5})
+    ctrl.on_tick(ctx)
+    ok, reason = ckpt.verify_manifest(d, 1)
+    assert ok, reason
+    assert ckpt.read_manifest(d, 1)["score"] == pytest.approx(0.5)
+    assert ctrl.last == {"step": 1, "blessed": True, "score": 0.5,
+                         "why": "pass"}
+    assert [k for k, _p in ctx.events] == ["deploy/gate"]
+    ctrl.on_tick(ctx)  # exactly-once: no duplicate gate event
+    assert len(ctx.events) == 1
+
+
+def test_controller_quarantines_nan_and_gate_max(tmp_path, monkeypatch):
+    from tensorflowonspark_tpu.workloads.deploy_loop import (
+        PromotionController,
+    )
+
+    d = str(tmp_path / "ckpt")
+    ctrl = PromotionController(d, eval_group="eval")
+    ctx = _FakeCtx()
+    _save(d, 1)
+    _eval_result(ctx, 1, {"loss": float("nan")})
+    ctrl.on_tick(ctx)
+    assert not ckpt.verify_manifest(d, 1)[0]
+    assert "tombstoned" in ckpt.verify_manifest(d, 1)[1]
+    assert ctrl.last["blessed"] is False
+    monkeypatch.setenv("TFOS_DEPLOY_GATE_MAX", "1.0")
+    _save(d, 2)
+    _eval_result(ctx, 2, {"loss": 3.0})
+    ctrl.on_tick(ctx)
+    assert "tombstoned" in ckpt.verify_manifest(d, 2)[1]
+    _save(d, 3)
+    _eval_result(ctx, 3, {"loss": 0.9})
+    ctrl.on_tick(ctx)
+    assert ckpt.verify_manifest(d, 3)[0]
+    assert ckpt.blessed_steps(d) == [3]
+
+
+def test_controller_skips_prejudged_manifest(tmp_path):
+    """A manifest already on disk (prior incarnation died between
+    effect and ledger record) is adopted, not re-judged."""
+    from tensorflowonspark_tpu.workloads.deploy_loop import (
+        PromotionController,
+    )
+
+    d = str(tmp_path / "ckpt")
+    _save(d, 1)
+    ckpt.bless_checkpoint(d, 1, score=0.7)
+    ctrl = PromotionController(d, eval_group="eval")
+    ctx = _FakeCtx()
+    ctrl.on_tick(ctx)
+    assert ctx.ledger.done("deploy_gate", 1)
+    assert ctx.events == []  # adopted silently, no duplicate gate event
+    assert ckpt.read_manifest(d, 1)["score"] == pytest.approx(0.7)
+
+
+# -- rollout state machine (workloads/deploy_loop.py DeployLoop) -------------
+
+def _sm_pool(live=(0, 1, 2)):
+    """A routing-state ReplicaPool skeleton whose in-band reload queues
+    are plain queues — the full canary/promote/rollback surface with no
+    engine underneath."""
+    import queue
+
+    pool = _bare_pool(list(live))
+    pool._inqs = {i: queue.Queue() for i in live}
+    return pool
+
+
+def _feed(pool, arm, ok=0, errors=0, ms=5.0):
+    for _ in range(ok):
+        pool._account({"t": time.monotonic() - ms / 1e3, "arm": arm},
+                      ok=True)
+    for _ in range(errors):
+        pool._account({"t": time.monotonic() - ms / 1e3, "arm": arm},
+                      ok=False)
+
+
+def _loop(pool, d, **kw):
+    from tensorflowonspark_tpu.workloads.deploy_loop import DeployLoop
+
+    kw.setdefault("pct", 50)
+    kw.setdefault("burn_secs", 5.0)
+    kw.setdefault("min_samples", 3)
+    return DeployLoop(pool, d, **kw)
+
+
+def test_deploy_bootstrap_promotes_first_blessed(tmp_path):
+    d = str(tmp_path / "ckpt")
+    _save(d, 1)
+    ckpt.bless_checkpoint(d, 1, score=0.5)
+    pool = _sm_pool()
+    loop = _loop(pool, d)
+    row = loop.pump(now=0.0)
+    assert row["state"] == "idle" and row["watermark"] == 1
+    assert loop.promotions == 1
+    assert loop.last_verdict["reasons"] == ["bootstrap"]
+    # whole pool pinned: every replica got a targeted reload
+    assert all(q.get_nowait() == ("reload", 1)
+               for q in pool._inqs.values())
+
+
+def test_deploy_promotes_clean_candidate(tmp_path):
+    d = str(tmp_path / "ckpt")
+    _save(d, 1)
+    ckpt.bless_checkpoint(d, 1, score=0.5)
+    pool = _sm_pool()
+    loop = _loop(pool, d)
+    loop.recover()
+    assert pool.watermark() == 1 and loop.promotions == 0
+    _save(d, 2)
+    ckpt.bless_checkpoint(d, 2, score=0.45)
+    row = loop.pump(now=100.0)
+    assert row["state"] == "burn"
+    assert pool.canary() == {"replicas": (0,), "version": 2, "pct": 50.0}
+    _feed(pool, "canary", ok=10)
+    _feed(pool, "baseline", ok=10)
+    assert loop.pump(now=101.0)["state"] == "burn"  # window still open
+    row = loop.pump(now=200.0)
+    assert row["state"] == "idle"
+    assert pool.watermark() == 2 and loop.promotions == 1
+    assert loop.last_verdict["verdict"] == "promote"
+    assert ckpt.verify_manifest(d, 2)[0]  # promoted, NOT tombstoned
+
+
+def test_deploy_rolls_back_on_eval_regression(tmp_path):
+    d = str(tmp_path / "ckpt")
+    _save(d, 1)
+    ckpt.bless_checkpoint(d, 1, score=0.5)
+    _save(d, 2)
+    ckpt.bless_checkpoint(d, 2, score=5.0)  # way past the 10% tol
+    pool = _sm_pool()
+    loop = _loop(pool, d)
+    pool.set_watermark(1)
+    loop.pump(now=0.0)
+    _feed(pool, "canary", ok=10)
+    _feed(pool, "baseline", ok=10)
+    row = loop.pump(now=50.0)
+    assert row["state"] == "idle"
+    assert loop.rollbacks == 1 and loop.promotions == 0
+    assert pool.watermark() == 1 and pool.canary() is None
+    assert any("eval regression" in r
+               for r in loop.last_verdict["reasons"])
+    # the candidate is quarantined and never re-offered
+    assert "tombstoned" in ckpt.verify_manifest(d, 2)[1]
+    assert loop.pump(now=60.0)["state"] == "idle"
+
+
+def test_deploy_rolls_back_on_slo_breach(tmp_path):
+    d = str(tmp_path / "ckpt")
+    _save(d, 1)
+    ckpt.bless_checkpoint(d, 1, score=0.5)
+    _save(d, 2)
+    ckpt.bless_checkpoint(d, 2, score=0.5)
+    pool = _sm_pool()
+    loop = _loop(pool, d)
+    pool.set_watermark(1)
+    loop.pump(now=0.0)
+    # canary errors half its traffic; the baseline is clean — the
+    # availability objective (99% ok) breaches on the canary arm only
+    _feed(pool, "canary", ok=10, errors=10)
+    _feed(pool, "baseline", ok=20)
+    loop.pump(now=50.0)
+    assert loop.rollbacks == 1
+    assert any("slo deploy_availability" in r
+               for r in loop.last_verdict["reasons"])
+    assert "tombstoned" in ckpt.verify_manifest(d, 2)[1]
+
+
+def test_deploy_insufficient_traffic_fails_safe(tmp_path):
+    d = str(tmp_path / "ckpt")
+    _save(d, 1)
+    ckpt.bless_checkpoint(d, 1, score=0.5)
+    _save(d, 2)
+    ckpt.bless_checkpoint(d, 2, score=0.5)
+    pool = _sm_pool()
+    loop = _loop(pool, d)
+    pool.set_watermark(1)
+    loop.pump(now=0.0)
+    loop.pump(now=50.0)  # burn expired with zero canary samples
+    assert loop.rollbacks == 1
+    assert any("insufficient canary traffic" in r
+               for r in loop.last_verdict["reasons"])
+
+
+def test_deploy_latency_regression_guard(tmp_path):
+    d = str(tmp_path / "ckpt")
+    _save(d, 1)
+    ckpt.bless_checkpoint(d, 1, score=0.5)
+    _save(d, 2)
+    ckpt.bless_checkpoint(d, 2, score=0.5)
+    pool = _sm_pool()
+    loop = _loop(pool, d, lat_tol=0.5)
+    pool.set_watermark(1)
+    loop.pump(now=0.0)
+    _feed(pool, "canary", ok=10, ms=500.0)   # 10x the baseline p95
+    _feed(pool, "baseline", ok=10, ms=20.0)
+    loop.pump(now=50.0)
+    assert loop.rollbacks == 1
+    assert any("latency regression" in r
+               for r in loop.last_verdict["reasons"])
+
+
+def test_deploy_fault_sites_rearm_and_retry(tmp_path, monkeypatch):
+    """An injected fault at a deploy site leaves the state machine
+    unchanged; the next pump retries the same transition."""
+    from tensorflowonspark_tpu.utils.faults import FaultInjected
+
+    d = str(tmp_path / "ckpt")
+    _save(d, 1)
+    ckpt.bless_checkpoint(d, 1, score=0.5)
+    _save(d, 2)
+    ckpt.bless_checkpoint(d, 2, score=0.45)
+    pool = _sm_pool()
+    loop = _loop(pool, d)
+    pool.set_watermark(1)
+    monkeypatch.setenv("TFOS_FAULT_PLAN",
+                       "deploy.canary:exc@1,deploy.promote:exc@1")
+    with pytest.raises(FaultInjected):
+        loop.pump(now=0.0)
+    assert loop.state == "idle" and pool.canary() is None  # unchanged
+    assert loop.pump(now=1.0)["state"] == "burn"  # re-armed, retried
+    _feed(pool, "canary", ok=10)
+    _feed(pool, "baseline", ok=10)
+    with pytest.raises(FaultInjected):
+        loop.pump(now=50.0)  # promote commit faulted
+    assert loop.state == "burn" and pool.watermark() == 1
+    assert loop.pump(now=51.0)["state"] == "idle"  # retried and won
+    assert pool.watermark() == 2 and loop.promotions == 1
+
+
+def test_deploy_table_reports_live_loops(tmp_path):
+    from tensorflowonspark_tpu.workloads.deploy_loop import deploy_table
+
+    d = str(tmp_path / "ckpt")
+    _save(d, 1)
+    ckpt.bless_checkpoint(d, 1, score=0.5)
+    pool = _sm_pool()
+    loop = _loop(pool, d)
+    loop.pump(now=0.0)
+    rows = [r for r in deploy_table() if r["ckpt_dir"] == d]
+    assert len(rows) == 1
+    assert rows[0]["watermark"] == 1 and rows[0]["state"] == "idle"
+    assert rows[0]["promotions"] == 1
