@@ -847,6 +847,7 @@ def main():
                      ("batch_inference", _inference_bench),
                      ("serve", _serve_bench),
                      ("elastic_serve", _elastic_serve_bench),
+                     ("deploy", _deploy_bench),
                      ("decode", _decode_bench),
                      ("data", _data_bench),
                      ("elastic", _elastic_bench),
@@ -1362,6 +1363,131 @@ def _elastic_serve_bench(dev, on_tpu):
             "regrown": pool["live"],
             "shed": stats["shed"],
             "dropped": stats["errors"],
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _deploy_probe_predict(params, inputs):
+    """Module-level probe model for the deploy lane (cloudpickled into
+    the CPU replicas): answers with the params version that served it."""
+    x = np.asarray(inputs["x"])
+    return {"version": np.full(x.shape[0],
+                               float(np.asarray(params["version"])))}
+
+
+def _deploy_bench(dev, on_tpu):
+    """Blessed-deployment lane (TFOS_BENCH_DEPLOY=0 to skip): the serve
+    lane's open-loop Poisson load against a 3-replica CPU pool while the
+    deployment loop (workloads/deploy_loop.py) walks one full staged
+    promotion and one full auto-rollback (docs/deployment.md).  Reports
+    the end-to-end commit latency of each transition (candidate blessed
+    -> pool converged), the under-rollout p99, and ``dropped`` —
+    client-visible request errors across both transitions, which the
+    zero-drop contract pins at 0 (bench_check gates it).  Replicas are
+    CPU-forced like the serve lanes: this measures rollout
+    choreography, not the chip."""
+    import shutil
+    import tempfile
+    import threading
+
+    from tensorflowonspark_tpu import serving
+    from tensorflowonspark_tpu.serving.decode import run_open_loop
+    from tensorflowonspark_tpu.utils import checkpoint as ckpt
+    from tensorflowonspark_tpu.workloads.deploy_loop import DeployLoop
+
+    n_requests = int(os.environ.get("TFOS_BENCH_DEPLOY_N", "240"))
+    rate_rps = float(os.environ.get("TFOS_BENCH_DEPLOY_RPS", "60"))
+    burn_secs = float(os.environ.get("TFOS_BENCH_DEPLOY_BURN", "1.0"))
+    tmp = tempfile.mkdtemp(prefix="tfos_bench_deploy_")
+    try:
+        d = os.path.join(tmp, "ckpt")
+
+        def publish(step, score):
+            # trainer + promotion-gate surrogate: checkpoint arrives
+            # already blessed (the gate itself is timed in the e2e test
+            # lane, not here — this lane times the rollout)
+            ckpt.save_checkpoint(
+                d, {"version": np.array(float(step))}, step=step)
+            ckpt.bless_checkpoint(d, step, score=score)
+
+        publish(1, 0.5)
+        spec = serving.ModelSpec(predict=_deploy_probe_predict,
+                                 ckpt_dir=d, jit=False)
+        x = np.zeros(8, np.float32)
+        with serving.Server(
+            spec, num_replicas=3, max_batch=32, max_delay_ms=5,
+            env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""},
+        ) as srv:
+            client = srv.client()
+            for _ in range(2):
+                client.predict({"x": x}, timeout=120)
+
+            loop = DeployLoop(srv.pool, d, pct=50, canary_count=1,
+                              burn_secs=burn_secs, min_samples=1,
+                              lat_tol=20.0)
+            loop.pump()  # bootstrap: pin the pool at step 1
+            stop = threading.Event()
+
+            def pumper():
+                while not stop.is_set():
+                    try:
+                        loop.pump()
+                    except Exception:  # noqa: BLE001 - lane must finish
+                        pass
+                    stop.wait(0.05)
+
+            pump_thread = threading.Thread(target=pumper, daemon=True)
+            pump_thread.start()
+
+            def request(i):
+                with telemetry.trace_span(telemetry.BENCH_REQUEST,
+                                          lane="deploy", req=i):
+                    return client.predict({"x": x}, timeout=120)
+
+            def wait_for(cond, what, timeout=60):
+                deadline = time.monotonic() + timeout
+                while time.monotonic() < deadline:
+                    if cond():
+                        return
+                    time.sleep(0.05)
+                raise RuntimeError(f"deploy lane: {what} never landed "
+                                   f"({loop.status()})")
+
+            # phase 1: a clean candidate canaries and promotes under load
+            t1 = time.perf_counter()
+            publish(2, 0.45)
+            stats1 = run_open_loop(
+                request, rate_rps=rate_rps, n_requests=n_requests,
+                seed=0, shed_exc=serving.Overloaded)
+            wait_for(lambda: loop.promotions >= 2, "promotion")
+            promote_s = time.perf_counter() - t1
+
+            # phase 2: a regressed candidate auto-rolls back under load
+            t2 = time.perf_counter()
+            publish(3, 50.0)  # 100x the blessed score: eval regression
+            stats2 = run_open_loop(
+                request, rate_rps=rate_rps, n_requests=n_requests,
+                seed=1, shed_exc=serving.Overloaded)
+            wait_for(lambda: loop.rollbacks >= 1, "rollback")
+            rollback_s = time.perf_counter() - t2
+            stop.set()
+            pump_thread.join(timeout=10)
+            watermark = srv.pool.watermark()
+
+        return {
+            "requests": stats1["requests"] + stats2["requests"],
+            "req_per_sec": round((stats1["completed_rps"]
+                                  + stats2["completed_rps"]) / 2, 3),
+            "p99_ms": max(stats1["latency_p99_ms"],
+                          stats2["latency_p99_ms"]),
+            "promote_s": round(promote_s, 3),
+            "rollback_s": round(rollback_s, 3),
+            "promotions": loop.promotions,
+            "rollbacks": loop.rollbacks,
+            "watermark": watermark,
+            "shed": stats1["shed"] + stats2["shed"],
+            "dropped": stats1["errors"] + stats2["errors"],
         }
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

@@ -78,6 +78,10 @@ LANES = (
     ("elastic_serve.degraded_p99_ms",
      ("extra", "elastic_serve", "degraded_p99_ms"), False),
     ("elastic_serve.dropped", ("extra", "elastic_serve", "dropped"), False),
+    ("deploy.promote_s", ("extra", "deploy", "promote_s"), False),
+    ("deploy.rollback_s", ("extra", "deploy", "rollback_s"), False),
+    ("deploy.p99_ms", ("extra", "deploy", "p99_ms"), False),
+    ("deploy.dropped", ("extra", "deploy", "dropped"), False),
     ("actors.ask_p50_ms", ("extra", "actors", "ask_p50_ms"), False),
     ("actors.ask_p99_ms", ("extra", "actors", "ask_p99_ms"), False),
     ("actors.respawn_resume_ms",
