@@ -132,9 +132,17 @@ class TFCluster:
         same supervision contract as feeder-mode ``train()``: a task or
         worker failure triggers recovery up to ``restarts`` times, and
         re-served streams resume at the per-trainer unit ledger instead
-        of re-feeding consumed data."""
+        of re-feeding consumed data.
+
+        Dispatch is **dynamic** (FCFS split dispatch, data/splits.py) by
+        default; ``TFOS_DATA_DISPATCH=static`` or
+        ``run(..., data_dispatch="static")`` selects the rank-strided
+        static sharding this method body implements."""
         from tensorflowonspark_tpu.data import service as data_service
 
+        if data_service.dispatch_mode(self.meta) == "dynamic":
+            return self._train_data_service_dynamic(
+                pipeline, num_epochs, feed_timeout, qname)
         n_workers = int(self.meta.get("data_workers") or
                         data_service.default_workers())
         assert num_epochs >= 0, "num_epochs cannot be negative"
@@ -161,6 +169,126 @@ class TFCluster:
                 if self._restarts_used >= self.restarts:
                     raise
                 self._recover(e)
+
+    def _train_data_service_dynamic(self, pipeline, num_epochs,
+                                    feed_timeout, qname):
+        """Dynamic-dispatch data service (the FCFS redesign of
+        ``_train_data_service``; docs/data.md "Dynamic sharding").
+
+        Per attempt: a fresh driver-side ``ActorSystem`` hosts the split
+        board (its manager KV/queues) and the supervised
+        ``SplitProvider`` actor; ``data_workers`` dynamic worker tasks
+        claim splits from it and push to whichever of their trainers is
+        least backlogged.  Exactly-once is per split id on the durable
+        ``split_feed`` rendezvous ledger — a recovery attempt spins up a
+        new board, and the provider re-posts only what the ledger is
+        missing.  When ``TFOS_DATA_MAX_WORKERS`` allows headroom, a
+        stall-driven autoscaler (data/autoscale.py) adds/retires worker
+        tasks by editing the board plan."""
+        from tensorflowonspark_tpu.actors.runtime import ActorSystem
+        from tensorflowonspark_tpu.data import autoscale as data_autoscale
+        from tensorflowonspark_tpu.data import service as data_service
+        from tensorflowonspark_tpu.data import splits as data_splits
+
+        n_workers = int(self.meta.get("data_workers") or
+                        data_service.default_workers())
+        assert num_epochs >= 0, "num_epochs cannot be negative"
+        num_epochs = max(1, int(num_epochs))
+        n_trainers = len(data_service.trainer_ranks(self.cluster_info))
+        # this job's split ledger starts empty (cf. reset_feed in train())
+        self.server.reset_feed(data_splits.split_feed(qname))
+        try:
+            max_workers = int(
+                os.environ.get(data_autoscale.MAX_WORKERS_ENV, "0"))
+        except ValueError:
+            max_workers = 0
+        max_workers = max(n_workers, max_workers)
+        try:
+            window = int(os.environ.get(data_splits.WINDOW_ENV, "0"))
+        except ValueError:
+            window = 0
+        window = window or max(16, 4 * max(1, n_trainers))
+        logger.info("data service (dynamic): %d worker task(s) feeding "
+                    "%d trainers, split window %d, max workers %d",
+                    n_workers, n_trainers, window, max_workers)
+        while True:
+            system = ActorSystem(capacity=1)
+            scaler = None
+            try:
+                board = data_splits.SplitBoard(system._mgr, qname)
+                board.set_plan(range(n_workers))
+                system.spawn(
+                    data_splits.SplitProvider(
+                        qname,
+                        server_addr=self.cluster_meta["server_addr"],
+                        num_epochs=num_epochs, window=window),
+                    "split-provider")
+                meta = dict(self.cluster_meta)
+                meta[data_service.SPLIT_BOARD_META] = {
+                    "address": tuple(system._mgr.address),
+                    "authkey": system._authkey,
+                }
+                fn = data_service.dynamic_serve_task(
+                    pipeline, self.cluster_info, meta, qname=qname,
+                    feed_timeout=feed_timeout)
+                if max_workers > n_workers:
+                    scaler = self._start_data_autoscaler(
+                        board, fn, n_workers, max_workers)
+                self.engine.parallelize(
+                    list(range(n_workers)), n_workers
+                ).foreach_partition(fn, spread=True,
+                                    retryable=self.restarts > 0)
+                return
+            except (engine_mod.TaskError, RuntimeError, TimeoutError) as e:
+                if self._restarts_used >= self.restarts:
+                    raise
+                self._recover(e)
+            finally:
+                if scaler is not None:
+                    scaler.stop()
+                system.stop()
+
+    def _start_data_autoscaler(self, board, serve_fn, n_workers,
+                               max_workers):
+        """Wire a ``StallAutoscaler`` to this cluster: the stall signal
+        is the trainers' published feed-wait counters (read through
+        their executor managers); scale-up launches one more dynamic
+        worker task and grows the board plan, scale-down shrinks the
+        plan (the worker drains and exits on its own)."""
+        from tensorflowonspark_tpu.data import autoscale as data_autoscale
+        from tensorflowonspark_tpu.data import service as data_service
+
+        mgrs = {}
+
+        def _snapshots():
+            out = {}
+            for rank, m in data_service.trainer_ranks(self.cluster_info):
+                try:
+                    mgr = mgrs.get(rank)
+                    if mgr is None:
+                        mgr = mgrs[rank] = node._get_manager(
+                            self.cluster_info, m["host"],
+                            m["executor_id"])
+                    for k, v in mgr.obs_snapshots().items():
+                        out[f"{rank}:{k}"] = v
+                except Exception:  # noqa: BLE001 - trainer mid-restart
+                    mgrs.pop(rank, None)
+            return out
+
+        def _scale_up(widx):
+            board.set_plan(board.plan() + [widx])
+            threading.Thread(
+                target=lambda: self.engine.parallelize(
+                    [widx], 1).foreach_partition(serve_fn),
+                name=f"tfos-data-scale-{widx}", daemon=True).start()
+
+        def _scale_down(widx):
+            board.set_plan([w for w in board.plan() if w != widx])
+
+        return data_autoscale.StallAutoscaler(
+            data_autoscale.obs_stall_reader(_snapshots),
+            _scale_up, _scale_down,
+            min_workers=n_workers, max_workers=max_workers).start()
 
     def _spawn_launcher(self):
         """(Re)launch the node job on a background thread
@@ -599,6 +727,7 @@ def run(
     background=None,
     restarts=0,
     data_workers=0,
+    data_dispatch=None,
     min_executors=0,
 ):
     """Starts the distributed cluster (parity: TFCluster.run :215-383).
@@ -618,6 +747,10 @@ def run(
     ``train()`` is given a ``data.Pipeline`` instead of a dataset
     (docs/data.md); 0 defers to ``TFOS_DATA_WORKERS`` (default 1) at
     ``train()`` time.
+
+    ``data_dispatch``: ``"dynamic"`` (default — FCFS split dispatch,
+    docs/data.md "Dynamic sharding") or ``"static"`` (rank-strided
+    shards, the pre-split behaviour); ``TFOS_DATA_DISPATCH`` overrides.
 
     ``min_executors``: elastic recovery floor (docs/elastic.md).  0
     (default) keeps today's rigid semantics: recovery must heal the
@@ -687,6 +820,7 @@ def run(
         "authkey": secrets.token_hex(16),
         "reservation_timeout": reservation_timeout,
         "data_workers": int(data_workers),
+        "data_dispatch": data_dispatch,
     }
 
     tf_status.clear()

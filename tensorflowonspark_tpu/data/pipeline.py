@@ -47,6 +47,8 @@ per-stage stall percentiles.
 
 from __future__ import annotations
 
+import hashlib
+import itertools
 import logging
 import os
 import pickle
@@ -61,8 +63,26 @@ from tensorflowonspark_tpu.utils import telemetry
 logger = logging.getLogger(__name__)
 
 PREFETCH_ENV = "TFOS_DATA_PREFETCH"
+CHUNKSIZE_ENV = "TFOS_DATA_CHUNKSIZE"
 
 _tls = threading.local()
+
+# Serializes the PYTHONPATH save/clear/restore around spawn-pool
+# construction (_ParallelMap): two pipelines building pools concurrently
+# would otherwise race the env mutation and could leak an empty
+# PYTHONPATH into one of them permanently.
+_SPAWN_ENV_LOCK = threading.Lock()
+
+
+def _pool_chunksize():
+    """``imap`` chunksize for parallel_map pools: ``TFOS_DATA_CHUNKSIZE``
+    (default 1).  chunksize=1 is one IPC round-trip per block — pure
+    overhead for small blocks; raising it batches blocks per worker
+    dispatch at the cost of coarser load balance."""
+    try:
+        return max(1, int(os.environ.get(CHUNKSIZE_ENV, "1")))
+    except ValueError:
+        return 1
 
 
 # --------------------------------------------------------------------------
@@ -306,6 +326,52 @@ class Pipeline:
             f"interleave() needs a multi-shard source upstream; "
             f"{type(self).__name__} has no sub-streams")
 
+    def _skip_fast(self, skip_blocks):
+        """Iterator starting at block ``skip_blocks`` WITHOUT recomputing
+        the prefix, or None when this node cannot (the generic path then
+        recomputes and discards).  Sources with O(1) random block access
+        (in-memory arrays) and completed caches override this — the
+        split-aware fast path dynamic split dispatch leans on so serving
+        split k is O(split), not O(k) (docs/data.md)."""
+        return None
+
+    def _skip_iter(self, skip_blocks):
+        """Block iterator from ``skip_blocks`` on: the fast path when the
+        node supports it, recompute-and-discard otherwise."""
+        if skip_blocks:
+            fast = self._skip_fast(skip_blocks)
+            if fast is not None:
+                if not telemetry.enabled():
+                    return fast
+                return _instrumented(self.stage_name, fast,
+                                     self._total_is_wait)
+        it = self._iter()
+        for _ in range(skip_blocks):
+            if next(it, None) is None:
+                return iter(())
+        return it
+
+    # -- identity ----------------------------------------------------------
+
+    def signature(self):
+        """Stable structural digest of the pipeline graph — stage chain +
+        content-relevant parameters — used to key the shared epoch cache
+        (``data.cache``): two pipeline objects with the same signature
+        produce the same block sequence (determinism contract), so M
+        consumers can share one materialized epoch.  Parameters that do
+        not change the produced records (pool width, prefetch depth) are
+        excluded."""
+        return hashlib.sha1(
+            "|".join(self._sig_parts()).encode()).hexdigest()[:16]
+
+    def _sig_parts(self):
+        parts = [] if self.parent is None else self.parent._sig_parts()
+        parts.append(self._sig())
+        return parts
+
+    def _sig(self):
+        return self.stage_name
+
     # -- transforms --------------------------------------------------------
 
     def map(self, fn):
@@ -384,14 +450,23 @@ class Pipeline:
 
     def blocks(self, skip_blocks=0):
         """Iterate host blocks.  ``skip_blocks``: resume support — the
-        first N blocks are recomputed and discarded (cheap relative to
-        re-feeding a trainer; the determinism contract makes the skip
-        land exactly where the previous consumer stopped)."""
-        it = self._iter()
-        for _ in range(skip_blocks):
-            if next(it, None) is None:
-                return iter(())
-        return it
+        first N blocks are skipped via the node's fast path when it has
+        one (arrays, completed caches), else recomputed and discarded
+        (cheap relative to re-feeding a trainer; the determinism
+        contract makes the skip land exactly where the previous consumer
+        stopped)."""
+        return self._skip_iter(skip_blocks)
+
+    def blocks_range(self, skip_blocks=0, num_blocks=None):
+        """Iterate at most ``num_blocks`` host blocks starting at block
+        ``skip_blocks`` — the split-serving terminal of dynamic split
+        dispatch (``data.splits``): split k of width B is
+        ``blocks_range(k * B, B)``.  ``num_blocks=None`` reads to the
+        end."""
+        it = self._skip_iter(skip_blocks)
+        if num_blocks is None:
+            return it
+        return itertools.islice(it, num_blocks)
 
     def chunks(self, skip_blocks=0):
         """Iterate ``marker.ColumnChunk`` wire chunks (one per block) —
@@ -415,6 +490,24 @@ class Pipeline:
                                          placement=placement)
 
 
+def _fn_digest(fn):
+    """Deterministic content digest of a stage callable for
+    ``signature()``: the pickle (or cloudpickle) bytes when obtainable,
+    else the qualified name — per-process identity as a last resort."""
+    payload = getattr(fn, "payload", None)  # _CloudFn carrier
+    if payload is None:
+        try:
+            payload = pickle.dumps(fn, protocol=4)
+        except Exception:  # noqa: BLE001 - closures without cloudpickle
+            try:
+                import cloudpickle
+
+                payload = cloudpickle.dumps(fn)
+            except Exception:  # noqa: BLE001
+                return f"{getattr(fn, '__qualname__', repr(fn))}@{id(fn)}"
+    return hashlib.sha1(payload).hexdigest()[:12]
+
+
 class _Map(Pipeline):
     stage_name = "map"
 
@@ -426,6 +519,16 @@ class _Map(Pipeline):
         fn = self.fn
         for block in self.parent._iter():
             yield fn(block)
+
+    def _skip_fast(self, skip_blocks):
+        # 1:1 block-wise: a skippable upstream makes this node skippable
+        fast = self.parent._skip_fast(skip_blocks)
+        if fast is None:
+            return None
+        return map(self.fn, fast)
+
+    def _sig(self):
+        return f"map:{_fn_digest(self.fn)}"
 
 
 class _ParallelMap(Pipeline):
@@ -447,21 +550,28 @@ class _ParallelMap(Pipeline):
         # at interpreter start and HANGS when the tunnel is down): clear
         # PYTHONPATH around the spawn — the spawn protocol ships the
         # parent's sys.path explicitly, so package imports still resolve.
-        saved = os.environ.get("PYTHONPATH")
-        os.environ["PYTHONPATH"] = ""
-        try:
-            pool = ctx.Pool(self.num_workers)
-        finally:
-            if saved is None:
-                os.environ.pop("PYTHONPATH", None)
-            else:
-                os.environ["PYTHONPATH"] = saved
+        # Under _SPAWN_ENV_LOCK: the mutation is process-global.
+        with _SPAWN_ENV_LOCK:
+            saved = os.environ.get("PYTHONPATH")
+            os.environ["PYTHONPATH"] = ""
+            try:
+                pool = ctx.Pool(self.num_workers)
+            finally:
+                if saved is None:
+                    os.environ.pop("PYTHONPATH", None)
+                else:
+                    os.environ["PYTHONPATH"] = saved
         try:
             imap = pool.imap if self.ordered else pool.imap_unordered
-            yield from imap(self.fn, self.parent._iter(), chunksize=1)
+            yield from imap(self.fn, self.parent._iter(),
+                            chunksize=_pool_chunksize())
         finally:
             pool.terminate()
             pool.join()
+
+    def _sig(self):
+        # num_workers does not change the produced records; ordered does
+        return f"parallel_map:{_fn_digest(self.fn)}:{int(self.ordered)}"
 
 
 class _Batch(Pipeline):
@@ -496,6 +606,9 @@ class _Batch(Pipeline):
         if have and not self.drop_remainder:
             yield _concat_blocks(
                 [_slice_block(b, off, block_len(b)) for b, off in pending])
+
+    def _sig(self):
+        return f"batch:{self.batch_size}:{int(self.drop_remainder)}"
 
 
 class _Shuffle(Pipeline):
@@ -540,6 +653,9 @@ class _Shuffle(Pipeline):
         if have:
             yield emit(window, have)
 
+    def _sig(self):
+        return f"shuffle:{self.buffer_size}:{self.seed}"
+
 
 class _Interleave(Pipeline):
     stage_name = "interleave"
@@ -567,6 +683,9 @@ class _Interleave(Pipeline):
                 nxt.append(it)
             live = nxt
 
+    def _sig(self):
+        return f"interleave:{self.cycle_length}"
+
 
 class _Cache(Pipeline):
     stage_name = "cache"
@@ -579,6 +698,7 @@ class _Cache(Pipeline):
         self._complete = False
         self._mem = []
         self._spill_path = None
+        self._spill_offsets = []  # byte offset of each spilled block
         self._finalizer = None
 
     def _col_bytes(self, block):
@@ -614,6 +734,7 @@ class _Cache(Pipeline):
 
         # first (filling) pass; only a COMPLETE pass publishes the cache
         mem, used, spill_f, spill_path = [], 0, None, None
+        offsets = []
         try:
             for block in self.parent._iter():
                 if spill_f is None and used + self._col_bytes(block) \
@@ -626,6 +747,7 @@ class _Cache(Pipeline):
                             prefix="tfos-data-cache-", suffix=".pkl",
                             dir=self.spill_dir)
                         spill_f = os.fdopen(fd, "wb")
+                    offsets.append(spill_f.tell())
                     pickle.dump(block, spill_f,
                                 protocol=pickle.HIGHEST_PROTOCOL)
                 yield block
@@ -639,12 +761,41 @@ class _Cache(Pipeline):
         with self._lock:
             if not self._complete:
                 self._mem, self._spill_path = mem, spill_path
+                self._spill_offsets = offsets
                 self._complete = True
                 if spill_path is not None:
                     self._finalizer = weakref.finalize(
                         self, _unlink_quiet, spill_path)
             elif spill_path is not None:  # raced: keep the first pass
                 os.unlink(spill_path)
+
+    def _skip_fast(self, skip_blocks):
+        """O(1) skip once the cache is complete: index into the memory
+        list, seek the spill file to the recorded per-block offset."""
+        with self._lock:
+            if not self._complete:
+                return None
+            replay_mem = list(self._mem)
+            spill = self._spill_path
+            offsets = list(self._spill_offsets)
+
+        def _replay():
+            if skip_blocks < len(replay_mem):
+                yield from replay_mem[skip_blocks:]
+                spill_at = 0
+            else:
+                spill_at = skip_blocks - len(replay_mem)
+            if spill is None or spill_at >= len(offsets):
+                return
+            with open(spill, "rb") as f:
+                f.seek(offsets[spill_at])
+                while True:
+                    try:
+                        yield pickle.load(f)
+                    except EOFError:
+                        return
+
+        return _replay()
 
     def purge(self):
         """Drop cached state (memory + spill file)."""
@@ -655,6 +806,7 @@ class _Cache(Pipeline):
                 self._finalizer()
                 self._finalizer = None
             self._spill_path = None
+            self._spill_offsets = []
 
 
 def _unlink_quiet(path):
@@ -731,6 +883,9 @@ class _Repeat(Pipeline):
             yield from self.parent._iter()
             epoch += 1
 
+    def _sig(self):
+        return f"repeat:{self.count}"
+
 
 class _Shard(Pipeline):
     stage_name = "shard"
@@ -752,6 +907,9 @@ class _Shard(Pipeline):
                 continue
             idx = np.arange(first, n, self.count)
             yield _take_rows(block, idx)
+
+    def _sig(self):
+        return f"shard:{self.index}:{self.count}"
 
 
 # --------------------------------------------------------------------------
@@ -790,6 +948,9 @@ class _TFRecordSource(Pipeline):
 
         return [one(f) for f in self.files]
 
+    def _sig(self):
+        return f"tfrecords:{self.block_size}:" + ",".join(self.files)
+
 
 class _ArraySource(Pipeline):
     stage_name = "arrays"
@@ -811,6 +972,27 @@ class _ArraySource(Pipeline):
         for lo in range(0, n, self.block_size):
             yield _slice_block(self.columns, lo, lo + self.block_size)
 
+    def _skip_fast(self, skip_blocks):
+        n = len(next(iter(self.columns.values())))
+        start = skip_blocks * self.block_size
+        return (_slice_block(self.columns, lo, lo + self.block_size)
+                for lo in range(start, n, self.block_size))
+
+    def _sig(self):
+        import numpy as np
+
+        parts = [f"arrays:{self.block_size}"]
+        for name in sorted(self.columns):
+            col = self.columns[name]
+            if isinstance(col, np.ndarray):
+                head = np.ascontiguousarray(col[:64]).tobytes()
+                fp = hashlib.sha1(head).hexdigest()[:8]
+                parts.append(
+                    f"{name}:{col.dtype.str}:{col.shape}:{fp}")
+            else:
+                parts.append(f"{name}:list:{len(col)}:{id(col)}")
+        return ";".join(parts)
+
 
 class _RowSource(Pipeline):
     stage_name = "rows"
@@ -831,6 +1013,9 @@ class _RowSource(Pipeline):
                 buf = []
         if buf:
             yield _rows_to_block(buf)
+
+    def _sig(self):
+        return f"rows:{self.block_size}:{id(self.rows)}"
 
 
 def from_tfrecords(source, block_size=1024):

@@ -307,6 +307,414 @@ class DataService:
 _NullClient = NullLedgerClient
 
 
+# --------------------------------------------------------------------------
+# dynamic FCFS split dispatch (data/splits.py is the control plane)
+
+
+SPLIT_BOARD_META = "split_board"  # cluster_meta key carrying board coords
+DISPATCH_ENV = "TFOS_DATA_DISPATCH"
+SHARED_CACHE_ENV = "TFOS_DATA_SHARED_CACHE"
+QUEUE_CAP_ENV = "TFOS_DATA_QUEUE_CAP"
+
+
+def default_split_blocks():
+    """Split width in blocks: ``TFOS_DATA_SPLIT_BLOCKS`` (8)."""
+    from tensorflowonspark_tpu.data import splits as _splits
+
+    try:
+        return max(1, int(os.environ.get(_splits.SPLIT_BLOCKS_ENV, "8")))
+    except ValueError:
+        return 8
+
+
+def dispatch_mode(cluster_meta=None):
+    """``"dynamic"`` (default) or ``"static"`` — env beats the
+    ``data_dispatch`` cluster-meta key beats the default."""
+    mode = os.environ.get(DISPATCH_ENV)
+    if not mode and cluster_meta:
+        mode = cluster_meta.get("data_dispatch")
+    mode = (mode or "dynamic").strip().lower()
+    if mode not in ("static", "dynamic"):
+        raise ValueError(f"unknown {DISPATCH_ENV}={mode!r} "
+                         "(want static|dynamic)")
+    return mode
+
+
+class DynamicDataService:
+    """One dynamic data worker: claim splits FCFS from the board, serve
+    their blocks to the least-loaded owned trainer, record each split in
+    the PDONE ledger once its records are consumption-safe.
+
+    Differences from the static :class:`DataService`:
+
+    - **what** to serve comes from the split queue (``data/splits.py``),
+      not a rank-strided shard — a slow trainer claims fewer splits
+      instead of stretching the epoch;
+    - **where** it goes is chosen per split: the least-loaded trainer
+      among those this worker owns under the board *plan* (the shm ring
+      is single-producer, so trainer rings are partitioned across the
+      live workers; the plan changing re-partitions them, which is how
+      autoscaling adds serving capacity);
+    - exactly-once is per split id on the ``split_feed`` ledger:
+      record-on-drain as before, plus chunk-level ``("split", sid, seq,
+      n)`` tags so a re-served split's already-consumed prefix is
+      dropped by the trainer's DataFeed instead of trained on twice;
+    - epoch replay reads the shared :mod:`data.cache` epoch cache
+      (decode once, replay from memory/spill) unless
+      ``TFOS_DATA_SHARED_CACHE=0``.
+    """
+
+    def __init__(self, pipeline, cluster_info, cluster_meta, qname="input",
+                 worker_index=0, split_blocks=None, feed_timeout=600,
+                 use_cache=None):
+        self.pipeline = pipeline
+        self.cluster_info = cluster_info
+        self.cluster_meta = cluster_meta
+        self.qname = qname
+        self.worker_index = int(worker_index)
+        self.split_blocks = (default_split_blocks() if split_blocks is None
+                             else max(1, int(split_blocks)))
+        self.feed_timeout = feed_timeout
+        if use_cache is None:
+            use_cache = os.environ.get(SHARED_CACHE_ENV, "1") != "0"
+        self.use_cache = bool(use_cache)
+        try:
+            self.queue_cap = max(
+                1, int(os.environ.get(QUEUE_CAP_ENV, "32")))
+        except ValueError:
+            self.queue_cap = 32
+        self._source = None
+
+    class _Sink:
+        __slots__ = ("rank", "meta", "mgr", "ring", "queue", "equeue",
+                     "pending", "lost")
+
+        def __init__(self, rank, meta):
+            self.rank = rank
+            self.meta = meta
+            self.mgr = None
+            self.ring = None
+            self.queue = None
+            self.equeue = None
+            self.pending = []   # sids pushed, awaiting drain before record
+            self.lost = False   # trainer terminating/stopped
+
+    # -- plan / ownership --------------------------------------------------
+
+    def _owned_ranks(self, plan, ranks):
+        """Trainer ranks this worker serves under ``plan`` (the board's
+        active-worker list): position-strided, so every trainer has
+        exactly one producer for its ring."""
+        if self.worker_index not in plan:
+            return []
+        pos = plan.index(self.worker_index)
+        return [r for r in ranks if r % len(plan) == pos]
+
+    def _open_sink(self, sink):
+        from tensorflowonspark_tpu import node as tfnode
+
+        sink.mgr = tfnode._get_manager(
+            self.cluster_info, sink.meta["host"], sink.meta["executor_id"])
+        telemetry.register_with(sink.mgr)
+        if str(sink.mgr.get("state")) in ("terminating", "stopped"):
+            sink.lost = True
+            return
+        # ring handover: the previous owner's producer flock may linger
+        # a beat after a plan change — retry instead of wedging on it
+        deadline = time.monotonic() + float(self.feed_timeout)
+        while True:
+            try:
+                sink.ring = tfnode._open_feed_ring(
+                    sink.mgr, self.qname, producer_nonblock=True)
+                break
+            except BlockingIOError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+        sink.queue = (None if sink.ring is not None
+                      else sink.mgr.get_queue(self.qname))
+        sink.equeue = sink.mgr.get_queue("error")
+
+    def _close_sink(self, sink):
+        if sink.ring is not None:
+            try:
+                sink.ring.close()
+            except Exception:  # noqa: BLE001 - teardown
+                pass
+            sink.ring = None
+
+    def _depth(self, sink):
+        """Backlog of one sink, for least-loaded target choice (bytes
+        for rings, queued-chunk count for manager queues — only ever
+        compared within one transport)."""
+        try:
+            if sink.ring is not None:
+                return sink.ring.qsize_bytes()
+            return sink.queue.qsize()
+        except Exception:  # noqa: BLE001 - depth is best-effort
+            return 0
+
+    # -- serving -----------------------------------------------------------
+
+    def _blocks_of(self, sid):
+        k = sid[1]
+        return self._source.blocks_range(k * self.split_blocks,
+                                         self.split_blocks)
+
+    def _push_pinned(self, sink, chunk):
+        """Push one chunk to its pinned trainer, waiting out a full
+        ring/queue; raises when the trainer errored, returns False when
+        it is terminating (stop serving, do not record)."""
+        from tensorflowonspark_tpu import node as tfnode
+
+        while True:
+            if sink.ring is not None:
+                try:
+                    sink.ring.put(chunk, timeout_ms=1000)
+                    return True
+                except TimeoutError:
+                    pass
+            else:
+                if sink.queue.qsize() < self.queue_cap:
+                    sink.queue.put(chunk, block=True)
+                    return True
+                time.sleep(0.05)
+            if str(sink.mgr.get("state")) in ("terminating", "stopped"):
+                sink.lost = True
+                return False
+            tfnode._raise_if_consumer_lost(sink.mgr, sink.equeue)
+
+    def _serve_split(self, board, client, sid, sink):
+        """Serve every block of ``sid`` to ``sink``; returns the block
+        count (0 = split past end of data)."""
+        from tensorflowonspark_tpu.data import splits as _splits
+        from tensorflowonspark_tpu.data.pipeline import block_to_chunk
+
+        seq = 0
+        pushed_records = 0
+        for block in self._blocks_of(sid):
+            faults.check("data.split_serve", worker=self.worker_index,
+                         sid=_splits.sid_str(sid), seq=seq)
+            chunk = block_to_chunk(block)
+            chunk.meta = ("split", sid, seq, seq + 1)
+            if not self._push_pinned(sink, chunk):
+                return -1  # trainer shutting down: drop, do not record
+            seq += 1
+            pushed_records += len(chunk)
+        if pushed_records:
+            metrics_registry.inc("tfos_data_records_total", pushed_records,
+                                 trainer=sink.rank)
+        return seq
+
+    def _record_split(self, board, client, sid):
+        from tensorflowonspark_tpu.data import splits as _splits
+
+        try:
+            client.partition_done(_splits.split_feed(self.qname),
+                                  _splits.sid_to_part(sid))
+        except Exception as e:  # noqa: BLE001 - accounting only
+            logger.warning("data worker %d: could not record split %s: %s",
+                           self.worker_index, _splits.sid_str(sid), e)
+            return
+        board.clear_claim(sid)
+        metrics_registry.inc("tfos_data_splits_served_total")
+        telemetry.event(telemetry.DATA_UNIT, worker=self.worker_index,
+                        split=_splits.sid_str(sid), epoch=sid[0])
+
+    def _flush_drained(self, board, client, sinks, block=False):
+        """Record pending splits whose ring the trainer drained.  The
+        non-blocking form runs once per loop; the blocking form (stream
+        end, ownership handoff) waits out the drain."""
+        from tensorflowonspark_tpu import node as tfnode
+
+        for sink in sinks:
+            if not sink.pending:
+                continue
+            if sink.lost:
+                sink.pending = []   # trainer gone: provider requeues
+                continue
+            if sink.ring is not None:
+                if block:
+                    tfnode._await_consumption(
+                        sink.mgr, lambda s=sink: s.ring.qsize_bytes() > 0,
+                        self.feed_timeout, poll=0.2)
+                elif sink.ring.qsize_bytes() > 0:
+                    continue
+            for sid in sink.pending:
+                self._record_split(board, client, sid)
+            sink.pending = []
+
+    # -- main loop ---------------------------------------------------------
+
+    def run(self):
+        """Claim-and-serve until the board declares completion (or this
+        worker is planned out); returns {"splits": n, "records": n}."""
+        from tensorflowonspark_tpu.data import cache as data_cache
+        from tensorflowonspark_tpu.data import splits as _splits
+
+        coords = self.cluster_meta[SPLIT_BOARD_META]
+        board = _splits.SplitBoard.connect(
+            coords["address"], coords["authkey"], self.qname)
+        hb = board.start_heartbeat(self.worker_index)
+        try:
+            client = rendezvous.Client(self.cluster_meta["server_addr"])
+        except Exception as e:  # noqa: BLE001 - standalone use, no ledger
+            logger.debug("data worker: rendezvous unavailable (%s)", e)
+            client = _NullClient()
+        self._source = (data_cache.shared(self.pipeline) if self.use_cache
+                        else self.pipeline)
+        ranks = trainer_ranks(self.cluster_info)
+        sinks = {r: DynamicDataService._Sink(r, m) for r, m in ranks}
+        all_ranks = sorted(sinks)
+        open_ranks = set()
+        last_pick = {}
+        pick_seq = 0
+        served = 0
+        t0 = time.perf_counter()
+        next_pub = 0.0
+        idle_t0 = None
+        try:
+            while True:
+                plan = board.plan() or [self.worker_index]
+                if self.worker_index not in plan:
+                    # scaled down: hand the rings over cleanly
+                    self._flush_drained(board, client,
+                                        list(sinks.values()), block=True)
+                    logger.info("data worker %d: planned out, exiting",
+                                self.worker_index)
+                    break
+                owned = self._owned_ranks(plan, all_ranks)
+                for r in list(open_ranks):
+                    if r not in owned:   # disowned: drain, record, release
+                        self._flush_drained(board, client, [sinks[r]],
+                                            block=True)
+                        self._close_sink(sinks[r])
+                        open_ranks.discard(r)
+                self._flush_drained(board, client,
+                                    [sinks[r] for r in open_ranks])
+                if board.complete():
+                    self._flush_drained(board, client,
+                                        [sinks[r] for r in open_ranks],
+                                        block=True)
+                    break
+                sid = board.claim_next(owned)
+                if sid is None:
+                    if idle_t0 is None:
+                        idle_t0 = time.perf_counter()
+                    time.sleep(0.05)
+                    continue
+                if idle_t0 is not None and telemetry.enabled():
+                    telemetry.record_span(
+                        "data/stage", 0.0, stage="split_queue_wait",
+                        wait_ms=round(
+                            (time.perf_counter() - idle_t0) * 1e3, 3),
+                        records=0, worker=self.worker_index)
+                idle_t0 = None
+                board.set_claim(sid, self.worker_index)
+                metrics_registry.inc("tfos_data_splits_claimed_total")
+                faults.check("data.split_claim", worker=self.worker_index,
+                             sid=_splits.sid_str(sid))
+                done = ()
+                try:
+                    done = client.fed_partitions(
+                        _splits.split_feed(self.qname))
+                except Exception:  # noqa: BLE001 - ledgerless harness
+                    pass
+                if _splits.sid_to_part(sid) in set(done):
+                    board.clear_claim(sid)   # raced a recorded re-serve
+                    continue
+                pin = board.pin_of(sid)
+                if pin is not None and pin in owned:
+                    rank = pin
+                else:
+                    live = [r for r in owned if not sinks[r].lost]
+                    if not live:
+                        break   # nothing left to serve into
+                    # least backlogged first; LRU round-robin breaks the
+                    # frequent all-drained tie (depth 0 everywhere) so
+                    # equal-speed trainers share splits evenly instead
+                    # of min() always electing the lowest rank
+                    rank = min(live, key=lambda r: (
+                        self._depth(sinks[r]) if r in open_ranks else 0,
+                        last_pick.get(r, -1)))
+                    last_pick[rank] = pick_seq
+                    pick_seq += 1
+                board.set_pin(sid, rank)   # pin BEFORE the first push
+                sink = sinks[rank]
+                if rank not in open_ranks:
+                    self._open_sink(sink)
+                    if sink.lost:
+                        continue   # claim goes stale -> provider requeues
+                    open_ranks.add(rank)
+                n = self._serve_split(board, client, sid, sink)
+                if n < 0:
+                    continue   # trainer shutting down mid-split
+                if n == 0:
+                    board.set_eof(sid[1])
+                    # an empty split is trivially consumption-safe
+                    self._record_split(board, client, sid)
+                    continue
+                if n < self.split_blocks:
+                    board.set_eof(sid[1] + 1)   # short split = the tail
+                served += 1
+                if sink.ring is not None:
+                    sink.pending.append(sid)
+                else:
+                    # manager-queue path: the queue lives in the trainer
+                    # manager, same exposure as the static queue path
+                    self._record_split(board, client, sid)
+                if (metrics_registry.enabled()
+                        and time.monotonic() >= next_pub):
+                    next_pub = (time.monotonic()
+                                + metrics_registry.interval())
+                    self._publish_obs(sinks, open_ranks)
+        finally:
+            hb.set()
+            self._publish_obs(sinks, open_ranks)
+            for r in open_ranks:
+                self._close_sink(sinks[r])
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001 - teardown
+                pass
+        telemetry.record_span(
+            "data/serve", time.perf_counter() - t0,
+            worker=self.worker_index, splits=served, dispatch="dynamic")
+        logger.info("data worker %d served %d splits", self.worker_index,
+                    served)
+        return {"splits": served}
+
+    def _publish_obs(self, sinks, open_ranks):
+        if not metrics_registry.enabled():
+            return
+        for r in sorted(open_ranks):
+            mgr = sinks[r].mgr
+            if mgr is not None and obs_publish.publish_once(
+                    mgr, f"data-{self.worker_index}", role="data"):
+                return
+
+
+def dynamic_serve_task(pipeline, cluster_info, cluster_meta, qname="input",
+                       split_blocks=None, feed_timeout=600):
+    """Engine closure running one dynamic data worker per partition —
+    the FCFS counterpart of :func:`serve_task`.  Also used by the
+    autoscaler to launch additional workers one at a time."""
+
+    def _serve(iterator):
+        items = list(iterator)
+        if items:
+            widx = int(items[0])
+        else:
+            widx = int(os.environ.get("TFOS_PARTITION_INDEX", "0"))
+        svc = DynamicDataService(
+            pipeline, cluster_info, cluster_meta, qname=qname,
+            worker_index=widx, split_blocks=split_blocks,
+            feed_timeout=feed_timeout)
+        svc.run()
+
+    return _serve
+
+
 def default_workers():
     """Worker count default: ``TFOS_DATA_WORKERS`` (1)."""
     try:

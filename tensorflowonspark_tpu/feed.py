@@ -52,7 +52,8 @@ def _user():
     return getpass.getuser()
 
 
-def open_feed_ring(mgr, qname="input", producer=False):
+def open_feed_ring(mgr, qname="input", producer=False,
+                   producer_nonblock=False):
     """Open the shm fast path advertised by the node, or None.
 
     THE transport handshake, shared by producer (feeder/shutdown closures)
@@ -69,7 +70,10 @@ def open_feed_ring(mgr, qname="input", producer=False):
     try:
         from tensorflowonspark_tpu.recordio import shm as shmq
 
-        return shmq.ShmQueue(str(ring_name), create=False, producer=producer)
+        return shmq.ShmQueue(str(ring_name), create=False, producer=producer,
+                             producer_nonblock=producer_nonblock)
+    except BlockingIOError:
+        raise  # ring busy, not broken: dynamic-dispatch handover retries
     except Exception as e:
         raise RuntimeError(
             f"node advertised shm feed ring {ring_name!r} but this process "
@@ -116,6 +120,13 @@ class DataFeed:
         self._buffer = []  # leftover records from a partially-consumed chunk
         self._colblock = None  # (ColumnChunk, offset): partially-consumed
         self._col_meta = {}  # tensor -> (dtype, trailing shape) last seen
+        # split-tagged delivery state (dynamic split dispatch): next
+        # expected chunk seq per split id.  A re-served split (worker
+        # SIGKILLed mid-split, provider requeued it pinned to this
+        # trainer) replays from seq 0; chunks below the expected seq were
+        # already consumed and are dropped here — the consumer half of
+        # the exactly-once contract (data/splits.py).
+        self._split_next = {}
         # The ring is single-consumer: a prefetch thread (infeed.py) and a
         # terminate() caller must never pop concurrently.  Gets poll under
         # this lock in short slices and re-check the stop flag between
@@ -171,6 +182,15 @@ class DataFeed:
             except TimeoutError:
                 continue
             faults.check("feed.get", eof=chunk is None)
+            tag = getattr(chunk, "meta", None)
+            if tag is not None and tag[0] == "split":
+                _kind, sid, seq, _nblocks = tag
+                expected = self._split_next.get(sid, 0)
+                if seq < expected:  # re-served prefix: already consumed
+                    metrics_registry.inc(
+                        "tfos_data_split_dup_chunks_total")
+                    continue
+                self._split_next[sid] = seq + 1
             break
         if t0 is not None:
             # ONE measurement feeds both layers (TrainMetrics.infeed_wait

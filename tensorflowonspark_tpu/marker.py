@@ -31,9 +31,9 @@ class ColumnChunk:
     (TFSparkNode.py:480-482).
     """
 
-    __slots__ = ("spec", "columns", "shapes")
+    __slots__ = ("spec", "columns", "shapes", "meta")
 
-    def __init__(self, spec, columns, shapes=None):
+    def __init__(self, spec, columns, shapes=None, meta=None):
         self.spec = spec          # [(dtype_code, width), ...]
         self.columns = columns    # tuple of np.ndarray, one per field
         # per-field original trailing shape for n-D tensor fields the
@@ -42,6 +42,19 @@ class ColumnChunk:
         # field was scalar/1-D already.  Consumers reshape VIEWS — the
         # flatten/unflatten round-trip copies nothing.
         self.shapes = shapes
+        # optional small delivery tag riding the wire with the chunk —
+        # dynamic split dispatch labels chunks ("split", sid, seq,
+        # nblocks) so DataFeed can drop the already-consumed prefix of a
+        # re-served split (data/splits.py exactly-once contract).  None
+        # for untagged (feeder / static-service) chunks.
+        self.meta = meta
+
+    def __getstate__(self):
+        return (self.spec, self.columns, self.shapes, self.meta)
+
+    def __setstate__(self, state):
+        self.spec, self.columns, self.shapes = state[:3]
+        self.meta = state[3] if len(state) > 3 else None
 
     def __len__(self):
         return len(self.columns[0]) if self.columns else 0

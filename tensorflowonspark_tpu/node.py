@@ -676,11 +676,12 @@ def _get_manager(cluster_info, host, executor_id):
     )
 
 
-def _open_feed_ring(mgr, qname):
+def _open_feed_ring(mgr, qname, producer_nonblock=False):
     """Producer-side handle on the shared transport handshake (feed.py)."""
     from tensorflowonspark_tpu.feed import open_feed_ring
 
-    return open_feed_ring(mgr, qname, producer=True)
+    return open_feed_ring(mgr, qname, producer=True,
+                          producer_nonblock=producer_nonblock)
 
 
 def _raise_if_consumer_lost(mgr, equeue):

@@ -34,7 +34,9 @@ def _decode_columnar(buf):
     from tensorflowonspark_tpu import marker as _marker
 
     hlen = int.from_bytes(bytes(buf[4:8]), "little")
-    spec, shapes, descrs = pickle.loads(bytes(buf[8:8 + hlen]))
+    hdr = pickle.loads(bytes(buf[8:8 + hlen]))
+    spec, shapes, descrs = hdr[:3]
+    meta = hdr[3] if len(hdr) > 3 else None
     off = _align8(8 + hlen)
     cols = []
     mv = memoryview(buf)
@@ -46,7 +48,7 @@ def _decode_columnar(buf):
         a = np.frombuffer(mv, dtype=dt, count=count, offset=off)
         cols.append(a.reshape(shape))
         off = _align8(off + a.nbytes)
-    return _marker.ColumnChunk(spec, tuple(cols), shapes=shapes)
+    return _marker.ColumnChunk(spec, tuple(cols), shapes=shapes, meta=meta)
 
 
 def _lock_path(name):
@@ -90,7 +92,8 @@ class ShmQueue:
     it replaces."""
 
     def __init__(self, name, capacity=64 << 20, create=False,
-                 open_timeout_ms=60000, producer=False):
+                 open_timeout_ms=60000, producer=False,
+                 producer_nonblock=False):
         lib = _native.load()
         if lib is None:
             raise RuntimeError("native library unavailable; ShmQueue disabled")
@@ -101,7 +104,20 @@ class ShmQueue:
             import fcntl
 
             self._lockf = open(_lock_path(name), "w")
-            fcntl.flock(self._lockf, fcntl.LOCK_EX)
+            if producer_nonblock:
+                # dynamic-dispatch ring handover: the new owner retries
+                # instead of wedging behind the old owner's session flock
+                try:
+                    fcntl.flock(self._lockf,
+                                fcntl.LOCK_EX | fcntl.LOCK_NB)
+                except OSError:
+                    self._lockf.close()
+                    self._lockf = None
+                    raise BlockingIOError(
+                        f"shm queue {name}: producer flock held by "
+                        "another session") from None
+            else:
+                fcntl.flock(self._lockf, fcntl.LOCK_EX)
         if create:
             self._h = lib.shq_create(name.encode(), capacity)
         else:
@@ -199,7 +215,8 @@ class ShmQueue:
             return False
         header = pickle.dumps(
             (obj.spec, getattr(obj, "shapes", None),
-             [(a.dtype.str, a.shape) for a in cols]),
+             [(a.dtype.str, a.shape) for a in cols],
+             getattr(obj, "meta", None)),
             protocol=pickle.HIGHEST_PROTOCOL)
         # pad so every column lands 8-byte aligned in the frame (the
         # consumer views them in place; unaligned int64/float64 views

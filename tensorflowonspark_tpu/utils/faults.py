@@ -74,6 +74,8 @@ SITES = (
     "feed.put",             # node.py feeder, before each chunk put
     "feed.get",             # feed.py DataFeed, after each chunk pop
     "data.serve",           # data/service.py worker, before each unit
+    "data.split_claim",     # data/service.py dynamic worker, after a claim
+    "data.split_serve",     # data/service.py dynamic worker, per chunk
     "rendezvous.register",  # rendezvous.py Client.register
     "rendezvous.query",     # rendezvous.py Client.await_reservations polls
     "checkpoint.save",      # utils/checkpoint.py save paths

@@ -103,6 +103,32 @@ CATALOG = {
         "counter", "Exactly-once ledger units recorded done."),
     "tfos_data_resumes_total": (
         "counter", "Shard-cursor resumes after a worker respawn."),
+    # dynamic split dispatch (data/splits.py provider + dynamic workers)
+    "tfos_data_splits_posted_total": (
+        "counter", "Split ids posted to the FCFS queue by the provider."),
+    "tfos_data_splits_claimed_total": (
+        "counter", "Splits claimed off the queue by this worker."),
+    "tfos_data_splits_served_total": (
+        "counter", "Splits recorded consumption-safe in the ledger."),
+    "tfos_data_splits_requeued_total": (
+        "counter", "Splits of dead claimants returned to the queue."),
+    "tfos_data_split_dup_chunks_total": (
+        "counter", "Re-served split chunks dropped by consumer dedup."),
+    "tfos_data_split_queue_depth": (
+        "gauge", "Split ids waiting in the shared FCFS queue."),
+    "tfos_data_workers": (
+        "gauge", "Dynamic data workers in the active plan (autoscaler)."),
+    # shared epoch cache (data/cache.py)
+    "tfos_data_cache_hits_total": (
+        "counter", "Shared-cache registry lookups that reused a cache."),
+    "tfos_data_cache_misses_total": (
+        "counter", "Shared-cache registry lookups that built a cache."),
+    "tfos_data_cache_spilled_total": (
+        "counter", "Cached blocks written to the disk spill."),
+    "tfos_data_cache_blocks": (
+        "gauge", "Blocks materialized in the epoch cache."),
+    "tfos_data_cache_bytes": (
+        "gauge", "Bytes resident in the epoch cache memory tier."),
     # serving (server process)
     "tfos_serve_requests_total": (
         "counter", "Serving requests, by status (ok|error|shed)."),

@@ -456,11 +456,16 @@ def test_mnist_data_service_survives_worker_kill(tmp_path, monkeypatch):
     monkeypatch.setenv(telemetry.DIR_ENV, str(telemetry_dir))
     for k in (telemetry.SPOOL_ENV, telemetry.ROLE_ENV, telemetry.NODE_ENV):
         monkeypatch.delenv(k, raising=False)  # stale leaks misroute sinks
+    # this e2e asserts the STATIC service's recovery semantics (unit
+    # ledger + shard-cursor resume); the dynamic default has its own
+    # kill e2e in test_data_splits.py
+    monkeypatch.setenv("TFOS_DATA_DISPATCH", "static")
     monkeypatch.chdir(tmp_path)
     engine = LocalEngine(2, env={
         "JAX_PLATFORMS": "cpu",
         "PYTHONPATH": "",  # drop the TPU-tunnel site hook
         "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "TFOS_DATA_DISPATCH": "static",
         faults.PLAN_ENV: "data.serve:kill@5",
     })
     try:
