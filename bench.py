@@ -1700,6 +1700,123 @@ def _data_bench(dev, on_tpu):
         mgr.set("state", "stopped")
         out["service_records_per_sec"] = round(got[0] / dt, 1)
         out["service_records"] = got[0]
+
+        # dynamic-split dispatch over the same wire: board + provider +
+        # one DynamicDataService worker, FCFS split claims (ISSUE 19)
+        from tensorflowonspark_tpu.data import splits as dsplits
+
+        bkey = secrets.token_bytes(16)
+        bmgr = tfmanager.start(bkey, [])
+        akey = secrets.token_bytes(16)
+        amgr = tfmanager.start(akey, ["input", "output", "error"])
+        ameta = {"executor_id": 0, "host": "localhost",
+                 "job_name": "worker", "addr": list(amgr.address),
+                 "authkey": akey.hex()}
+        board = dsplits.SplitBoard(bmgr, "input")
+        board.set_plan([0])
+
+        class _Ctx:
+            def __init__(self, m):
+                self.mgr = m
+                self._kv = {}
+
+            def kv_get(self, k):
+                return self._kv.get(k)
+
+            def kv_set(self, k, v):
+                self._kv[k] = v
+
+        ictx = _Ctx(bmgr)
+        provider = dsplits.SplitProvider("input", server_addr=None,
+                                         num_epochs=1, window=16)
+        provider.on_start(ictx)
+        dyn = dsvc.DynamicDataService(
+            pipe, cluster_info=[ameta],
+            cluster_meta={dsvc.SPLIT_BOARD_META: {
+                "address": tuple(bmgr.address), "authkey": bkey}},
+            qname="input", worker_index=0, use_cache=False)
+        # ledger-less board: completion needs the provider to see done
+        # splits, which NullLedgerClient never reports — drain by count
+        dfeed = DataFeed(amgr, train_mode=True,
+                         input_mapping={"x": "x", "y": "y"})
+        dgot = [0]
+
+        def ddrain():
+            while dgot[0] < total:
+                cols = dfeed.next_batch_columns(batch)
+                dgot[0] += len(cols.get("y", ()))
+
+        stop_tick = threading.Event()
+
+        def dtick():
+            while not stop_tick.is_set() and not board.complete():
+                provider.on_tick(ictx)
+                time.sleep(0.02)
+
+        t0 = time.perf_counter()
+        dconsumer = threading.Thread(target=ddrain, daemon=True)
+        dworker = threading.Thread(target=dyn.run, daemon=True)
+        ticker = threading.Thread(target=dtick, daemon=True)
+        dconsumer.start()
+        dworker.start()
+        ticker.start()
+        dconsumer.join(timeout=120)
+        dt = time.perf_counter() - t0
+        # ledger-less lane: completion is declared here, not by the
+        # provider — lets the worker exit instead of idling on claims
+        board.set_complete()
+        stop_tick.set()
+        dworker.join(timeout=30)
+        amgr.set("state", "stopped")
+        out["dynamic_records_per_sec"] = round(dgot[0] / dt, 1)
+        out["dynamic_records"] = dgot[0]
+
+        # shared epoch cache: decode once, replay from memory/spill
+        from tensorflowonspark_tpu.data import cache as dcache
+
+        epoch_cache = dcache.EpochCache(pipe)
+        t0 = time.perf_counter()
+        seen = sum(len(b["y"]) for b in epoch_cache.blocks_range())
+        out["cache_cold_records_per_sec"] = round(
+            seen / (time.perf_counter() - t0), 1)
+        t0 = time.perf_counter()
+        seen = sum(len(b["y"]) for b in epoch_cache.blocks_range())
+        hit_rps = seen / (time.perf_counter() - t0)
+        epoch_cache.close()
+        out["cache_hit_records_per_sec"] = round(hit_rps, 1)
+        if out["pipeline_records_per_sec"]:
+            # the ISSUE 19 shared-cache gate: second consumer reads at
+            # >= 5x the cold pipeline rec/s
+            out["cache_hit_speedup"] = round(
+                hit_rps / out["pipeline_records_per_sec"], 2)
+
+        # straggler A/B (TFOS_BENCH_DATA_STRAGGLER=0 to skip): the
+        # stress_fed service-dynamic lane in a scrubbed-CPU subprocess
+        # (host-only: spawns consumer processes, never touches jax)
+        if os.environ.get("TFOS_BENCH_DATA_STRAGGLER", "1") != "0":
+            import subprocess
+            import sys
+
+            env = dict(os.environ)
+            env.update({"PYTHONPATH": "", "JAX_PLATFORMS": "cpu"})
+            root = os.path.dirname(os.path.abspath(__file__))
+            proc = subprocess.run(
+                [sys.executable,
+                 os.path.join(root, "scripts", "stress_fed.py"),
+                 "--mode", "service-dynamic"],
+                capture_output=True, text=True, timeout=300, cwd=root,
+                env=env)
+            line = None
+            for ln in reversed(proc.stdout.splitlines()):
+                ln = ln.strip()
+                if ln.startswith("{"):
+                    line = json.loads(ln)
+                    break
+            if proc.returncode or line is None:
+                out["straggler_error"] = (proc.stderr or proc.stdout)[-200:]
+            else:
+                out["straggler_ratio"] = line["straggler_ratio"]
+                out["straggler_speedup"] = line["straggler_speedup"]
         return out
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

@@ -59,6 +59,12 @@ LANES = (
      ("extra", "data", "pipeline_records_per_sec"), True),
     ("data.service_rec_s",
      ("extra", "data", "service_records_per_sec"), True),
+    ("data.dynamic_rec_s",
+     ("extra", "data", "dynamic_records_per_sec"), True),
+    ("data.straggler_speedup",
+     ("extra", "data", "straggler_speedup"), True),
+    ("data.cache_hit_rec_s",
+     ("extra", "data", "cache_hit_records_per_sec"), True),
     ("tfrecord.columnar_rec_s",
      ("extra", "tfrecord_read", "columnar_records_per_sec"), True),
     ("serve.req_s", ("extra", "serve", "req_per_sec"), True),
@@ -87,6 +93,14 @@ LANES = (
     ("actors.respawn_resume_ms",
      ("extra", "actors", "respawn_resume_ms"), False),
 )
+
+# Absolute floors, checked on the NEWEST line alone (no baseline
+# needed): lanes whose meaning is a contract, not a trend.  A
+# straggler_speedup near 1.0 means dispatch regressed to static-shard
+# behavior — that must fail even if the prior round was just as bad.
+FLOORS = {
+    "data.straggler_speedup": 1.2,
+}
 
 
 def _dig(obj, path):
@@ -218,8 +232,14 @@ def main(argv=None):
             return 0
         (old_path, old_lanes), (new_path, new_lanes) = usable[-2], usable[-1]
 
+    floor_bad = [(label, new_lanes[label], floor)
+                 for label, floor in sorted(FLOORS.items())
+                 if label in new_lanes and new_lanes[label] < floor]
+    for label, value, floor in floor_bad:
+        print(f"  {label:<24} {value:>12.2f} below floor {floor:.2f}  "
+              f"REGRESSED")
     rows = compare(old_lanes, new_lanes, args.tolerance)
-    if not rows:
+    if not rows and not floor_bad:
         print("bench_check: SKIP (no lane present in both "
               f"{os.path.basename(old_path)} and "
               f"{os.path.basename(new_path)})")
@@ -231,6 +251,13 @@ def main(argv=None):
                   f"{rel:>+7.1%}  {flag}")
     bad = [r for r in rows if r[4]]
     names = (os.path.basename(new_path), os.path.basename(old_path))
+    if floor_bad:
+        label, value, floor = floor_bad[0]
+        print(f"bench_check: REGRESSION {label} {value:.2f} below "
+              f"absolute floor {floor:.2f} newest={names[0]} "
+              f"[{len(floor_bad)} floor violation(s), "
+              f"{len(bad)}/{len(rows)} lanes regressed]")
+        return 1
     if bad:
         worst = max(bad, key=lambda r: abs(r[3]))
         print(f"bench_check: REGRESSION {worst[0]} {worst[3]:+.1%} "

@@ -133,13 +133,26 @@ class TFManager(BaseManager):
     ``get``/``set`` are real instance methods over a DictProxy-backed KV
     store: registering raw callables would hand back AutoProxy objects
     whose ``==`` never matches plain values.
+
+    The DictProxy is minted once per TFManager instance and reused
+    (``_kv``): proxy *creation* is several small-packet roundtrips
+    (~0.2s under delayed-ACK), which made every KV get/set cost 200ms+
+    while queue proxies — created once — stayed sub-millisecond.  The
+    cached proxy is thread-safe: BaseProxy keeps per-thread
+    connections.
     """
 
+    def _kv(self):
+        p = getattr(self, "_kv_proxy", None)
+        if p is None:
+            p = self._kv_proxy = self.kv()
+        return p
+
     def get(self, key):
-        return self.kv().get(key)
+        return self._kv().get(key)
 
     def set(self, key, value):
-        self.kv().update({key: value})
+        self._kv().update({key: value})
 
     # -- telemetry drain channel (utils/telemetry.py) ------------------
     # Every process on this executor advertises its spool dir under a
@@ -148,11 +161,11 @@ class TFManager(BaseManager):
     # for the set and collects the JSONL files (node.drain_telemetry).
 
     def telemetry_register(self, path):
-        self.kv().update({"telemetry_spool:" + str(path): str(path)})
+        self._kv().update({"telemetry_spool:" + str(path): str(path)})
 
     def telemetry_spools(self):
         prefix = "telemetry_spool:"
-        return sorted(v for k, v in self.kv().items()
+        return sorted(v for k, v in self._kv().items()
                       if str(k).startswith(prefix))
 
     # -- live metrics channel (utils/metrics_registry.py, obs/) --------
@@ -162,10 +175,10 @@ class TFManager(BaseManager):
     # driver's ObsServer polls the set and merges them into /metrics.
 
     def obs_publish(self, node_id, payload):
-        self.kv().update({OBS_KEY + str(node_id): payload})
+        self._kv().update({OBS_KEY + str(node_id): payload})
 
     def obs_snapshots(self):
-        return {str(k)[len(OBS_KEY):]: v for k, v in self.kv().items()
+        return {str(k)[len(OBS_KEY):]: v for k, v in self._kv().items()
                 if str(k).startswith(OBS_KEY)}
 
     # -- obs control plane (obs/http.py -> obs/publish.py) -------------
@@ -177,16 +190,16 @@ class TFManager(BaseManager):
     # read-modify-write — same discipline as the channels above.
 
     def obs_control_post(self, node_id, directive):
-        self.kv().update({CTL_KEY + str(node_id): directive})
+        self._kv().update({CTL_KEY + str(node_id): directive})
 
     def obs_control_take(self, node_id):
-        return self.kv().pop(CTL_KEY + str(node_id), None)
+        return self._kv().pop(CTL_KEY + str(node_id), None)
 
     def obs_control_ack(self, node_id, result):
-        self.kv().update({ACK_KEY + str(node_id): result})
+        self._kv().update({ACK_KEY + str(node_id): result})
 
     def obs_control_result(self, node_id):
-        return self.kv().get(ACK_KEY + str(node_id))
+        return self._kv().get(ACK_KEY + str(node_id))
 
 
 # Server-side singletons (one manager process per executor).  Queues are
