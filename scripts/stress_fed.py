@@ -417,7 +417,7 @@ def main():
         # cluster nodes (feed/wait comes from DataFeed when enabled)
         telemetry.configure(node_id="stress-fed", role="stress")
     if args.mode == "service-dynamic":
-        with telemetry.span("stress_fed/service-dynamic",
+        with telemetry.span(f"stress_fed/{args.mode}",
                             trainers=args.trainers,
                             slow_factor=args.slow_factor) as sp:
             r = run_service_dynamic(trainers=args.trainers,

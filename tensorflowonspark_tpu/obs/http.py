@@ -159,6 +159,63 @@ def node_summary(snap):
     return {k: v for k, v in out.items() if v is not None}
 
 
+# Dynamic-split dispatch rollup (/statusz "data" section).  The split
+# lifecycle is spread across processes — the provider actor posts and
+# requeues, data workers claim and serve, trainers count dup-dropped
+# chunks, the autoscaler owns the worker gauge — so counters are summed
+# across every snapshot and gauges take the largest reporter (one
+# provider / one autoscaler in practice; workers' cache gauges sum).
+_DATA_COUNTERS = (
+    ("splits_posted", "tfos_data_splits_posted_total"),
+    ("splits_claimed", "tfos_data_splits_claimed_total"),
+    ("splits_served", "tfos_data_splits_served_total"),
+    ("splits_requeued", "tfos_data_splits_requeued_total"),
+    ("dup_chunks", "tfos_data_split_dup_chunks_total"),
+    ("records", "tfos_data_records_total"),
+    ("cache_hits", "tfos_data_cache_hits_total"),
+    ("cache_misses", "tfos_data_cache_misses_total"),
+    ("cache_spilled", "tfos_data_cache_spilled_total"),
+)
+
+_DATA_SUM_GAUGES = (
+    ("cache_blocks", "tfos_data_cache_blocks"),
+    ("cache_bytes", "tfos_data_cache_bytes"),
+)
+
+_DATA_MAX_GAUGES = (
+    ("split_queue_depth", "tfos_data_split_queue_depth"),
+    ("workers", "tfos_data_workers"),
+)
+
+
+def data_summary(snaps):
+    """Cross-process dynamic-split rollup, or None when no process
+    reported a split/cache/worker metric (static-shard runs keep
+    /statusz unchanged)."""
+    out = {}
+    for key, name in _DATA_COUNTERS:
+        vals = [v for v in (_metric_total(s, name) for s in snaps)
+                if v is not None]
+        if vals:
+            out[key] = sum(vals)
+    for key, name in _DATA_SUM_GAUGES:
+        vals = [v for v in (_metric_gauge(s, name) for s in snaps)
+                if v is not None]
+        if vals:
+            out[key] = sum(vals)
+    for key, name in _DATA_MAX_GAUGES:
+        vals = [v for v in (_metric_gauge(s, name) for s in snaps)
+                if v is not None]
+        if vals:
+            out[key] = max(vals)
+    # the headline trainer-facing number only matters on dynamic runs;
+    # records alone (also counted by the static service) doesn't rate a
+    # section of its own
+    if set(out) <= {"records"}:
+        return None
+    return out or None
+
+
 class ObsServer:
     """See module docstring.  ``cluster`` is a ``TFCluster`` (may be
     None for a driver-only / serving-only endpoint)."""
@@ -511,6 +568,15 @@ class ObsServer:
             deploys = []
         if deploys:
             out["deploy"] = deploys
+        # Dynamic-split dispatch: split lifecycle counters and dispatch
+        # gauges rolled up across every reporting process (data/).
+        snaps = [ent.get("metrics")
+                 for ent in self._node_entries().values()]
+        if driver:
+            snaps.append(driver)
+        data = data_summary(snaps)
+        if data:
+            out["data"] = data
         return out
 
     def render_slo(self):

@@ -626,3 +626,45 @@ def test_dynamic_service_survives_worker_kill(tmp_path, monkeypatch):
         f"exactness violated: missing="
     f"{[k for k in range(E2E_N) if counts.get(k, 0) < 1][:10]} "
         f"dup={[k for k, v in counts.items() if v > 1][:10]}")
+
+
+# -- /statusz data section -------------------------------------------------
+
+
+def test_statusz_data_summary_rolls_up_across_processes():
+    """The /statusz "data" section sums split/cache counters across the
+    provider, workers and trainers, sums per-process cache gauges, and
+    takes the largest reporter for the singleton gauges (queue depth,
+    worker count).  Static-shard runs — records but no split/cache
+    activity — get no section at all."""
+    from tensorflowonspark_tpu.obs import http as obs_http
+
+    def snap(**kv):
+        return {name: {"series": [{"value": float(v)}]}
+                for name, v in kv.items()}
+
+    provider = snap(tfos_data_splits_posted_total=10,
+                    tfos_data_splits_requeued_total=1,
+                    tfos_data_split_queue_depth=3)
+    w1 = snap(tfos_data_splits_claimed_total=4,
+              tfos_data_splits_served_total=4,
+              tfos_data_records_total=400,
+              tfos_data_cache_bytes=100,
+              tfos_data_cache_blocks=2)
+    w2 = snap(tfos_data_splits_claimed_total=5,
+              tfos_data_splits_served_total=5,
+              tfos_data_records_total=500,
+              tfos_data_cache_bytes=50,
+              tfos_data_cache_blocks=1)
+    scaler = snap(tfos_data_workers=2)
+    got = obs_http.data_summary([provider, w1, w2, scaler, None])
+    assert got == {
+        "splits_posted": 10.0, "splits_claimed": 9.0,
+        "splits_served": 9.0, "splits_requeued": 1.0,
+        "records": 900.0, "cache_bytes": 150.0, "cache_blocks": 3.0,
+        "split_queue_depth": 3.0, "workers": 2.0,
+    }
+    # records alone (static service) doesn't rate a section
+    assert obs_http.data_summary(
+        [snap(tfos_data_records_total=5)]) is None
+    assert obs_http.data_summary([None, {}]) is None
