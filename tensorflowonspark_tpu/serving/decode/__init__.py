@@ -29,6 +29,7 @@ The model half lives in ``models/transformer.py`` (``prefill``,
 
 from tensorflowonspark_tpu.serving.decode.loadgen import (  # noqa: F401
     run_open_loop,
+    session_route_ids,
     shared_prefix_prompts,
 )
 from tensorflowonspark_tpu.serving.decode.sampling import (  # noqa: F401

@@ -63,21 +63,42 @@ def shared_prefix_prompts(n, *, vocab_size, prefix_pool=4, prefix_len=64,
     return prompts, pool
 
 
+def session_route_ids(n, sessions, seed=0):
+    """``n`` request route-ids drawn from ``sessions`` stable sessions.
+
+    Returns a list of ``n`` strings ``"s<k>"`` assigned by a seeded rng,
+    modelling returning clients: every request carrying the same id is
+    the *same* conversation, so the fabric's affinity router should land
+    it on the replica whose paged KV cache already holds its prefix.
+    Pure stdlib; pair with ``run_open_loop(..., route_fn=ids.__getitem__)``.
+    """
+    rng = random.Random(seed)
+    return [f"s{rng.randrange(int(sessions))}" for _ in range(int(n))]
+
+
 def run_open_loop(request_fn, *, rate_rps, n_requests, seed=0,
-                  shed_exc=None):
+                  shed_exc=None, route_fn=None):
     """Fire ``n_requests`` calls of ``request_fn(i)`` at Poisson arrivals
     of ``rate_rps`` and aggregate SLO stats.
 
     ``request_fn`` runs on a per-arrival thread.  It may return None (a
     plain request — only wall latency is recorded) or a dict with any of
     ``ttft_ms`` (float), ``token_ms`` (list of per-token gap floats),
-    ``tokens`` (int count).  Raising ``shed_exc`` counts as a shed;
-    any other exception counts as an error.  Neither stops the run —
-    an open loop keeps offering load.
+    ``tokens`` (int count), ``affinity`` (``"hit"``/``"miss"``/
+    ``"fallback"`` as stamped by the fabric router).  Raising
+    ``shed_exc`` counts as a shed; any other exception counts as an
+    error.  Neither stops the run — an open loop keeps offering load.
+
+    ``route_fn`` (optional) maps the request index to a stable session
+    route-id (see :func:`session_route_ids`); when given, requests are
+    fired as ``request_fn(i, route_fn(i))`` so the caller can thread the
+    id to ``Server.generate(route_id=...)``.
 
     Returns one stats dict: request/shed/error counts, offered vs
     completed rate, latency p50/p99, TTFT p50/p99 and pooled per-token
-    p50/p99 (when any request reported them), and aggregate tokens/s.
+    p50/p99 (when any request reported them), aggregate tokens/s, and —
+    when any request reported an affinity outcome — affinity
+    hit/miss/fallback counts plus ``affinity_hit_rate``.
     """
     rng = random.Random(seed)
     arrivals, t = [], 0.0
@@ -88,11 +109,15 @@ def run_open_loop(request_fn, *, rate_rps, n_requests, seed=0,
     lock = threading.Lock()
     latency_ms, ttft_ms, token_ms = [], [], []
     counts = {"completed": 0, "shed": 0, "errors": 0, "tokens": 0}
+    affinity = {"hit": 0, "miss": 0, "fallback": 0}
 
     def _one(i):
         t0 = time.perf_counter()
         try:
-            out = request_fn(i)
+            if route_fn is not None:
+                out = request_fn(i, route_fn(i))
+            else:
+                out = request_fn(i)
         except Exception as e:  # noqa: BLE001 - classified, never raised
             key = ("shed" if shed_exc is not None
                    and isinstance(e, shed_exc) else "errors")
@@ -108,6 +133,8 @@ def run_open_loop(request_fn, *, rate_rps, n_requests, seed=0,
                     ttft_ms.append(float(out["ttft_ms"]))
                 token_ms.extend(float(g) for g in out.get("token_ms") or ())
                 counts["tokens"] += int(out.get("tokens") or 0)
+                if out.get("affinity") in affinity:
+                    affinity[out["affinity"]] += 1
 
     threads = []
     start = time.perf_counter()
@@ -146,4 +173,10 @@ def run_open_loop(request_fn, *, rate_rps, n_requests, seed=0,
     if counts["tokens"]:
         out["tokens"] = counts["tokens"]
         out["tokens_per_sec"] = round(counts["tokens"] / wall, 2)
+    routed = sum(affinity.values())
+    if routed:
+        out["affinity_hits"] = affinity["hit"]
+        out["affinity_misses"] = affinity["miss"]
+        out["affinity_fallbacks"] = affinity["fallback"]
+        out["affinity_hit_rate"] = round(affinity["hit"] / routed, 4)
     return out

@@ -171,16 +171,19 @@ class PendingSession(ResolveOnce):
     """
 
     __slots__ = ("id", "prompt", "max_tokens", "eos_id", "sampling",
-                 "trace", "t_submit", "_ledger")
+                 "trace", "route_id", "t_submit", "_ledger")
 
     def __init__(self, sid, prompt, max_tokens, eos_id, sampling=None,
-                 trace=None):
+                 trace=None, route_id=None):
         super().__init__()
         self.id = sid
         self.prompt = [int(t) for t in prompt]
         self.max_tokens = int(max_tokens)
         self.eos_id = eos_id
         self.sampling = sampling
+        self.route_id = route_id   # session-affinity key: a fabric
+        # router pins returning sessions to the replica whose paged KV
+        # cache still holds their prefix blocks (serving/fabric)
         self.trace = trace         # W3C traceparent string (or None);
         # rides the dispatch blob so replica-side decode spans join the
         # request's trace tree (docs/telemetry.md "Causal tracing")

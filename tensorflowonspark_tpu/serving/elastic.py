@@ -324,14 +324,22 @@ class ElasticReplicaPool(ReplicaPool):
 
     def _accept_mirror(self, version):
         """Should a ``params_sync`` at ``version`` replace the adopt
-        mirror?  Latest-wins — UNLESS a promotion watermark is set
-        (ROADMAP item 6 follow-on): mid-canary the canary arm syncs the
-        unblessed candidate, and a replica regrown from the mirror must
-        adopt the *blessed* version, not the candidate.  With a
-        watermark W: prefer the newest version <= W; a version > W is
-        taken only when the mirror is empty (candidate params beat no
-        params) or the mirror itself is already past W."""
+        mirror?  Keyed to the pool's pinned version, never plain
+        latest-wins (ROADMAP item 6 follow-on, closed with the fabric
+        PR): mid-canary the canary arm syncs the unblessed candidate,
+        and a replica regrown from the mirror must adopt the *blessed*
+        version, not the candidate.  Without a promotion watermark the
+        HOT-RELOAD watermark (the step the latest-wins watcher actually
+        broadcast, replicas.reload_watermark) pins acceptance instead —
+        so a respawn that cold-booted at a newer, never-broadcast
+        checkpoint cannot smuggle it into the mirror ahead of the
+        version the survivors serve.  With a watermark W: prefer the
+        newest version <= W; a version > W is taken only when the
+        mirror is empty (candidate params beat no params) or the mirror
+        itself is already past W."""
         wm = self.watermark()
+        if wm is None:
+            wm = self.reload_watermark()
         cur = self._mirror_version
         if wm is None:
             return cur is None or version >= cur

@@ -1,0 +1,36 @@
+"""Pod-scale serving fabric (docs/serving.md "Pod-scale fabric").
+
+Parity note: the reference federates many hosts under one driver for
+*training* (TFCluster.py); its serving story stops at offline batch
+inference (Inference.scala:27-79).  This subsystem is the serving-side
+counterpart, PARITY.md §2.2: cross-host replica dispatch over the
+manager wire, queue-driven replica autoscaling, and session/prefix-
+affinity routing for the decode tier's paged KV caches.
+
+Pieces:
+  - :mod:`~tensorflowonspark_tpu.serving.fabric.affinity` —
+    consistent-hash ring + bounded LRU route bindings (pure);
+  - :mod:`~tensorflowonspark_tpu.serving.fabric.host` — the per-host
+    engine task: N replica worker threads, each with its own predictor
+    and decode engine;
+  - :mod:`~tensorflowonspark_tpu.serving.fabric.router` — driver-side
+    pool-protocol router (``Server(..., fabric=True)`` mounts it):
+    InFlightTable-backed dispatch, SIGKILL failover, affinity routing,
+    plan actuation;
+  - :mod:`~tensorflowonspark_tpu.serving.fabric.autoscale` — the
+    supervised ``ServeAutoscaler`` actor (hysteresis kernel shape from
+    ``data/autoscale.py``) over the router's queue-vs-worker signal.
+"""
+
+from tensorflowonspark_tpu.serving.fabric.affinity import (  # noqa: F401
+    AffinityMap,
+    Ring,
+)
+from tensorflowonspark_tpu.serving.fabric.autoscale import (  # noqa: F401
+    ServeAutoscaler,
+)
+from tensorflowonspark_tpu.serving.fabric.router import (  # noqa: F401
+    FabricRouter,
+    fabric_table,
+    num_hosts_default,
+)

@@ -483,6 +483,7 @@ class ReplicaPool:
         # accumulators for the controller's burn-window verdict
         self._canary = None          # {"replicas", "version", "pct"}
         self._watermark = None       # blessed step the pool is pinned to
+        self._reload_watermark = None  # newest latest-wins broadcast step
         self._arm_stats = None       # arm -> {"n", "errors", "ms": [...]}
         self._stats_replies = {}
         self._stats_event = threading.Event()
@@ -682,6 +683,16 @@ class ReplicaPool:
     def watermark(self):
         with self._lock:
             return self._watermark
+
+    def reload_watermark(self):
+        """Newest step the latest-wins reload watcher has broadcast
+        (None before the first broadcast).  The fabric router and the
+        elastic pool's mirror refresh key respawn convergence on it
+        when no promotion watermark pins the pool: a respawn must adopt
+        the version the survivors actually serve, not whatever
+        checkpoint happens to be newest at its boot instant."""
+        with self._lock:
+            return self._reload_watermark
 
     def set_canary(self, replicas, version, pct):
         """Open a canary: pin ``replicas`` at candidate ``version`` (in-
@@ -1054,6 +1065,8 @@ class ReplicaPool:
             if step is None or step == last:
                 continue
             last = step
+            with self._lock:
+                self._reload_watermark = step
             metrics_registry.inc("tfos_serve_reloads_total")
             telemetry.event(telemetry.SERVE_RELOAD, step=step)
             logger.info("hot-reload: broadcasting checkpoint step %d", step)

@@ -196,7 +196,8 @@ class Server:
                  max_delay_ms=None, queue_max=None, engine=None, env=None,
                  request_timeout=None, decode_queue_max=None,
                  seq_axis=None, seq_cap=None, elastic=False,
-                 logical_replicas=None):
+                 logical_replicas=None, fabric=False, fabric_hosts=None,
+                 replicas_per_host=None, autoscale=False):
         self.spec = spec
         self.stats = SLOStats()
         self.decode_stats = DecodeStats()
@@ -208,7 +209,19 @@ class Server:
         # way the batcher's queue bound does (docs/serving.md "Degrade
         # by resize"); 1.0 until the pool reports otherwise
         self._decode_capacity = 1.0
-        if elastic or logical_replicas:
+        if fabric or fabric_hosts:
+            # pod-scale fabric: multi-host dispatch + session-affinity
+            # routing + optional autoscaling (docs/serving.md
+            # "Pod-scale fabric")
+            from tensorflowonspark_tpu.serving.fabric import FabricRouter
+
+            self.pool = FabricRouter(
+                spec, num_hosts=fabric_hosts,
+                replicas_per_host=replicas_per_host or 1,
+                engine=engine, env=env,
+                request_timeout=self.request_timeout,
+                autoscale=autoscale)
+        elif elastic or logical_replicas:
             from tensorflowonspark_tpu.serving.elastic import (
                 ElasticReplicaPool,
             )
@@ -312,10 +325,17 @@ class Server:
 
     def generate(self, prompt, max_tokens=None, eos_id=None, timeout=None,
                  temperature=None, top_k=None, top_p=None, seed=None,
-                 trace=None):
+                 trace=None, route_id=None):
         """One autoregressive decode session: ``prompt`` is a list of
         int token ids; returns ``{"tokens": [...], "ttft_ms", "token_ms"
         (per-token gaps), "total_ms", ...engine meta}``.
+
+        ``route_id`` is an opaque session-affinity key: with a fabric
+        pool, requests sharing a route id land on the replica whose
+        paged KV cache still holds their prefix blocks (docs/serving.md
+        "Pod-scale fabric"); the result meta then carries the routing
+        outcome under ``"affinity"`` (hit/miss/fallback).  Other pools
+        ignore it.
 
         ``trace`` optionally links the session into a caller's trace
         (W3C-traceparent string or TraceContext); the context is
@@ -354,10 +374,10 @@ class Server:
         with telemetry.trace_span(telemetry.SERVE_GENERATE, header=trace,
                                   prompt_len=len(prompt)):
             return self._generate_traced(prompt, max_tokens, eos_id,
-                                         timeout, sampling)
+                                         timeout, sampling, route_id)
 
     def _generate_traced(self, prompt, max_tokens, eos_id, timeout,
-                         sampling):
+                         sampling, route_id=None):
         depth = self.pool.outstanding_sessions()
         limit = max(1, int(round(self.decode_queue_max
                                  * self._decode_capacity))) \
@@ -377,7 +397,8 @@ class Server:
             or _decode.max_tokens_default(),
             self.spec.decode.eos_id if eos_id is None else eos_id,
             sampling=sampling,
-            trace=ctx.to_header() if ctx is not None else None)
+            trace=ctx.to_header() if ctx is not None else None,
+            route_id=None if route_id is None else str(route_id))
         self.pool.dispatch_session(session)
         try:
             out = session.result(timeout or self.request_timeout)
@@ -518,10 +539,11 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _do_generate(self, srv):
         """POST /v1/generate: ``{"prompt": [ids], "max_tokens"?,
-        "eos_id"?, "temperature"?, "top_k"?, "top_p"?, "seed"?}`` ->
-        the session result dict (docs/serving.md).  Oversized prompts
-        and out-of-range sampling knobs are client errors (400), never
-        replica-side crashes."""
+        "eos_id"?, "temperature"?, "top_k"?, "top_p"?, "seed"?,
+        "route_id"?}`` -> the session result dict (docs/serving.md).
+        ``route_id`` is the session-affinity key a fabric pool routes
+        on.  Oversized prompts and out-of-range sampling knobs are
+        client errors (400), never replica-side crashes."""
         try:
             length = int(self.headers.get("Content-Length", 0))
             payload = json.loads(self.rfile.read(length) or b"{}")
@@ -541,7 +563,8 @@ class _Handler(BaseHTTPRequestHandler):
                                top_k=payload.get("top_k"),
                                top_p=payload.get("top_p"),
                                seed=payload.get("seed"),
-                               trace=self.headers.get("traceparent"))
+                               trace=self.headers.get("traceparent"),
+                               route_id=payload.get("route_id"))
         except ValueError as e:
             # oversized/empty prompt, bad sampling range: client error
             self._reply(400, {"error": str(e)})
@@ -604,6 +627,18 @@ def build_parser():
     p.add_argument("--logical_replicas", type=int, default=None,
                    help="logical capacity for --elastic "
                         "(default: num_replicas)")
+    p.add_argument("--fabric", action="store_true",
+                   help="pod-scale fabric pool: multi-host dispatch + "
+                        "session-affinity routing (docs/serving.md "
+                        "'Pod-scale fabric')")
+    p.add_argument("--fabric_hosts", type=int, default=None,
+                   help="fabric host processes "
+                        f"(default ${'{'}TFOS_FABRIC_HOSTS{'}'} or 2)")
+    p.add_argument("--replicas_per_host", type=int, default=None,
+                   help="initial replicas per fabric host (default 1)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="run the ServeAutoscaler over the fabric "
+                        "(TFOS_SERVE_MIN/MAX_REPLICAS clamp per host)")
     return p
 
 
@@ -619,7 +654,11 @@ def main(argv=None):
                     max_delay_ms=args.max_delay_ms,
                     queue_max=args.queue_max,
                     elastic=args.elastic,
-                    logical_replicas=args.logical_replicas)
+                    logical_replicas=args.logical_replicas,
+                    fabric=args.fabric,
+                    fabric_hosts=args.fabric_hosts,
+                    replicas_per_host=args.replicas_per_host,
+                    autoscale=args.autoscale)
     server.start()
     logger.info("serving on http://%s:%d (POST /v1/predict)",
                 args.host, args.port)

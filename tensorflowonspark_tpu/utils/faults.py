@@ -84,6 +84,8 @@ SITES = (
     "actor.tick",           # actors/runtime.py idle tick, before on_tick
     "serve.dispatch",       # serving/replicas.py, before routing a request
     "serve.resize",         # serving/elastic.py, before a pool resize
+    "serve.fabric_dispatch",  # serving/fabric/router.py, before a dispatch
+    "serve.fabric_route",   # serving/fabric/router.py, affinity route pick
     "decode.step",          # serving/decode/scheduler.py engine loop body
     "deploy.canary",        # workloads/deploy_loop.py, before opening canary
     "deploy.promote",       # workloads/deploy_loop.py, before promote commit

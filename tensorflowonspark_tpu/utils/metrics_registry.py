@@ -151,6 +151,24 @@ CATALOG = {
     "tfos_serve_resize_seconds": (
         "histogram", "Elastic pool resize duration (generation bump to "
                      "last replica reshard ack), seconds."),
+    # serving fabric (serving/fabric/ — driver process)
+    "tfos_fabric_hosts": (
+        "gauge", "Live fabric host processes."),
+    "tfos_fabric_replicas": (
+        "gauge", "Replica workers across live fabric hosts."),
+    "tfos_fabric_queue_depth": (
+        "gauge", "In-flight fabric dispatches (batches + sessions)."),
+    "tfos_fabric_dispatches_total": (
+        "counter", "Fabric dispatches, by kind (batch|gen)."),
+    "tfos_fabric_affinity_total": (
+        "counter", "Fabric session routing decisions, by outcome "
+                   "(hit|miss|fallback)."),
+    "tfos_fabric_redispatches_total": (
+        "counter", "In-flight work resent after a fabric host died, "
+                   "by kind (batch|gen)."),
+    "tfos_fabric_scale_events_total": (
+        "counter", "Autoscale plans actuated by the fabric router, by "
+                   "direction (up|down)."),
     # decode (serving/decode/ — server process + replica engines)
     "tfos_decode_sessions_total": (
         "counter", "Decode sessions, by status (ok|error|shed)."),
