@@ -849,6 +849,7 @@ def main():
                      ("elastic_serve", _elastic_serve_bench),
                      ("deploy", _deploy_bench),
                      ("decode", _decode_bench),
+                     ("serve_fabric", _fabric_bench),
                      ("data", _data_bench),
                      ("elastic", _elastic_bench),
                      ("actors", _actors_bench)):
@@ -1612,6 +1613,126 @@ def _decode_bench(dev, on_tpu):
             "nosharing_ttft_p50_ms": nosharing.get("ttft_p50_ms"),
             "replicas": replicas,
             "slots": slots,
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _fabric_bench(dev, on_tpu):
+    """Pod-scale fabric lane (TFOS_BENCH_SERVE_FABRIC=0 to skip): the
+    decode lane's open-loop Poisson sessions against a multi-host
+    fabric (``Server(fabric=True)``, >=2 host processes) with stable
+    per-session route ids, while (a) the autoscaler grows replicas
+    1 -> N under the induced queueing and (b) the host an affinity-bound
+    session targets is SIGKILLed a third of the way through the arrival
+    schedule (docs/serving.md "Pod-scale fabric").  Reports p99 across
+    the whole run (bench_check gates no-regression as replicas scale),
+    ``dropped`` — client-visible errors, pinned at 0 by the zero-drop
+    contract — plus ``affinity_hit_rate`` and the actuated
+    ``scale_ups``.  Hosts are CPU-forced like every serving lane: this
+    measures fabric choreography, not the chip."""
+    import shutil
+    import signal
+    import tempfile
+    import threading
+
+    import jax
+
+    from tensorflowonspark_tpu import serving
+    from tensorflowonspark_tpu.models import transformer as _tfm
+    from tensorflowonspark_tpu.serving.decode import (run_open_loop,
+                                                      session_route_ids)
+    from tensorflowonspark_tpu.utils import checkpoint as ckpt
+
+    hosts = int(os.environ.get("TFOS_BENCH_FABRIC_HOSTS", "2"))
+    n_sessions = int(os.environ.get("TFOS_BENCH_FABRIC_N", "48"))
+    rate_rps = float(os.environ.get("TFOS_BENCH_FABRIC_RPS", "16"))
+    max_tokens = int(os.environ.get("TFOS_BENCH_FABRIC_TOKENS", "12"))
+    route_sessions = int(os.environ.get("TFOS_BENCH_FABRIC_SESSIONS", "8"))
+    cfg = _tfm.Config(vocab_size=61, dim=32, n_layers=2, n_heads=2,
+                      max_seq=64, dtype="float32", attn_impl="reference")
+    tmp = tempfile.mkdtemp(prefix="tfos_bench_fabric_")
+    try:
+        params = _tfm.init(jax.random.PRNGKey(0), cfg)
+        export = os.path.join(tmp, "export")
+        ckpt.export_model(export, params, metadata={})
+        spec = serving.ModelSpec(
+            export_dir=export,
+            decode=serving.DecodeSpec(cfg, slots=4, max_tokens=max_tokens))
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(1, cfg.vocab_size,
+                                size=5 + i % 8).tolist()
+                   for i in range(n_sessions)]
+        ids = session_route_ids(n_sessions, sessions=route_sessions,
+                                seed=1)
+        # low=0.0 suppresses mid-run scale-DOWN so the lane measures a
+        # clean 1 -> N growth; the router's LIFO retire is the slow
+        # lane's business (tests/test_fabric.py)
+        with serving.Server(
+            spec, fabric=True, fabric_hosts=hosts, replicas_per_host=1,
+            request_timeout=300, decode_queue_max=4 * n_sessions,
+            autoscale={"min_replicas": 1, "max_replicas": 3,
+                       "high": 1.5, "low": 0.0, "cooldown": 1.0,
+                       "tick_secs": 0.2},
+            env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""},
+        ) as srv:
+            # warmup: pay jax import + prefill/decode compiles on every
+            # host before the clock starts, and bind the kill victim
+            for _ in range(2 * hosts):
+                srv.generate(prompts[0], max_tokens=2, timeout=300)
+            srv.generate(prompts[0], max_tokens=2, timeout=300,
+                         route_id=ids[0])
+            victim = srv.pool.affinity_binding(ids[0])[0]
+            kill_at = max(1, n_sessions // 3)
+            killed = {"pid": None}
+
+            def session(i, route_id):
+                if i == kill_at and killed["pid"] is None:
+                    pid = srv.pool.host_pids().get(victim)
+                    if pid:
+                        killed["pid"] = pid
+                        os.kill(pid, signal.SIGKILL)
+                with telemetry.trace_span(telemetry.BENCH_REQUEST,
+                                          lane="serve_fabric", req=i):
+                    out = srv.generate(prompts[i], max_tokens=max_tokens,
+                                       timeout=300, route_id=route_id)
+                return {"ttft_ms": out.get("ttft_ms"),
+                        "tokens": len(out.get("tokens") or ()),
+                        "affinity": out.get("affinity")}
+
+            stats = run_open_loop(session, rate_rps=rate_rps,
+                                  n_requests=n_sessions, seed=0,
+                                  shed_exc=serving.Overloaded,
+                                  route_fn=ids.__getitem__)
+            # regrow: wait for the killed host's respawn so the lane
+            # reports the restored fabric, not a race
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if len(srv.pool.live_replicas()) == hosts:
+                    break
+                time.sleep(0.2)
+            desc = srv.pool.describe()
+
+        return {
+            "sessions": stats["requests"],
+            "completed": stats["completed"],
+            "req_per_sec": stats["completed_rps"],
+            "offered_rps": stats["offered_rps"],
+            "p50_ms": stats["latency_p50_ms"],
+            "p99_ms": stats["latency_p99_ms"],
+            "ttft_p50_ms": stats.get("ttft_p50_ms"),
+            "ttft_p99_ms": stats.get("ttft_p99_ms"),
+            "tokens_per_sec": stats.get("tokens_per_sec", 0.0),
+            "shed": stats["shed"],
+            "dropped": stats["errors"],
+            "affinity_hit_rate": stats.get("affinity_hit_rate", 0.0),
+            "affinity_hits": stats.get("affinity_hits", 0),
+            "affinity_fallbacks": stats.get("affinity_fallbacks", 0),
+            "hosts": hosts,
+            "replicas_final": desc["replicas"],
+            "scale_ups": desc["scale_ups"],
+            "redispatched": desc["redispatched"],
+            "respawns": desc["respawns"],
         }
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

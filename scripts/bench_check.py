@@ -77,6 +77,12 @@ LANES = (
      ("extra", "decode", "prefix_hit_rate"), True),
     ("decode.prefill_tok_saved",
      ("extra", "decode", "prefill_tokens_saved"), True),
+    ("fabric.req_s", ("extra", "serve_fabric", "req_per_sec"), True),
+    ("fabric.p99_ms", ("extra", "serve_fabric", "p99_ms"), False),
+    ("fabric.dropped", ("extra", "serve_fabric", "dropped"), False),
+    ("fabric.affinity_hit_rate",
+     ("extra", "serve_fabric", "affinity_hit_rate"), True),
+    ("fabric.scale_ups", ("extra", "serve_fabric", "scale_ups"), True),
     ("elastic.resize_ms", ("extra", "elastic", "resize_ms"), False),
     ("elastic.reshard_ms", ("extra", "elastic", "reshard_ms"), False),
     ("elastic_serve.resize_ms",
@@ -98,9 +104,26 @@ LANES = (
 # needed): lanes whose meaning is a contract, not a trend.  A
 # straggler_speedup near 1.0 means dispatch regressed to static-shard
 # behavior — that must fail even if the prior round was just as bad.
+# fabric.scale_ups < 1 means the autoscaler provably never scaled under
+# the lane's induced queueing; a zero affinity_hit_rate means session
+# routing stopped landing returning sessions on their bound replica.
 FLOORS = {
     "data.straggler_speedup": 1.2,
+    "fabric.scale_ups": 1.0,
+    "fabric.affinity_hit_rate": 0.001,
 }
+
+# Absolute ceilings, the floors' mirror: fabric.dropped is the fabric
+# lane's zero-drop contract (client-visible errors across the mid-run
+# SIGKILL), pinned at 0 regardless of what the prior round did.
+CEILINGS = {
+    "fabric.dropped": 0.0,
+}
+
+# Contract lanes whose round-over-round trend is meaningless (how MANY
+# times the autoscaler stepped is load-shape, not performance): gated
+# by FLOORS/CEILINGS above, excluded from the relative comparison.
+FLOOR_ONLY = frozenset({"fabric.scale_ups", "fabric.affinity_hit_rate"})
 
 
 def _dig(obj, path):
@@ -178,6 +201,8 @@ def compare(old_lanes, new_lanes, tolerance):
     """[(label, old, new, rel_change, regressed)] over shared lanes."""
     rows = []
     for label, _path, hib in LANES:
+        if label in FLOOR_ONLY:
+            continue
         if label not in old_lanes or label not in new_lanes:
             continue
         old, new = old_lanes[label], new_lanes[label]
@@ -238,6 +263,13 @@ def main(argv=None):
     for label, value, floor in floor_bad:
         print(f"  {label:<24} {value:>12.2f} below floor {floor:.2f}  "
               f"REGRESSED")
+    ceil_bad = [(label, new_lanes[label], ceil)
+                for label, ceil in sorted(CEILINGS.items())
+                if label in new_lanes and new_lanes[label] > ceil]
+    for label, value, ceil in ceil_bad:
+        print(f"  {label:<24} {value:>12.2f} above ceiling {ceil:.2f}  "
+              f"REGRESSED")
+    floor_bad += ceil_bad
     rows = compare(old_lanes, new_lanes, args.tolerance)
     if not rows and not floor_bad:
         print("bench_check: SKIP (no lane present in both "
@@ -252,10 +284,10 @@ def main(argv=None):
     bad = [r for r in rows if r[4]]
     names = (os.path.basename(new_path), os.path.basename(old_path))
     if floor_bad:
-        label, value, floor = floor_bad[0]
-        print(f"bench_check: REGRESSION {label} {value:.2f} below "
-              f"absolute floor {floor:.2f} newest={names[0]} "
-              f"[{len(floor_bad)} floor violation(s), "
+        label, value, bound = floor_bad[0]
+        print(f"bench_check: REGRESSION {label} {value:.2f} outside "
+              f"absolute bound {bound:.2f} newest={names[0]} "
+              f"[{len(floor_bad)} floor/ceiling violation(s), "
               f"{len(bad)}/{len(rows)} lanes regressed]")
         return 1
     if bad:

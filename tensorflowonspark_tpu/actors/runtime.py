@@ -426,13 +426,29 @@ class ActorGroup:
                 telemetry.event("actor/up", group=self.name, actor=idx,
                                 pid=pid, epoch=epoch)
                 if respawned:
+                    # A respawn can beat the monitor's death poll — in
+                    # that ordering the lost-path never ran, the epoch
+                    # was never bumped, and the dead incarnation would
+                    # stay unfenced forever.  Fence here too: future
+                    # mail and the redispatch below carry a NEWER epoch
+                    # (fencing drops only envelopes older than a
+                    # member's boot epoch, so the live member keeps
+                    # accepting; when the scan did win this is a second
+                    # bump, which is harmless for the same reason).
+                    with self._epoch_lock:
+                        self._epochs[idx] += 1
+                        fence = self._epochs[idx]
+                    try:
+                        self._mgr.set(mailbox.epoch_key(self.name, idx),
+                                      fence)
+                    except Exception:  # noqa: BLE001 - manager teardown
+                        pass
                     self.respawns_observed += 1
                     metrics_registry.inc("tfos_actor_respawns_total",
                                          group=self.name)
                     telemetry.event("actor/respawn", group=self.name,
                                     actor=idx, pid=pid, epoch=epoch)
-                    # A respawn can beat the monitor's death poll, so
-                    # this is the authoritative failover trigger: the
+                    # This is the authoritative failover trigger: the
                     # dead incarnation's popped asks are gone; queued
                     # ones will at worst be answered twice (futures
                     # resolve once).  Re-dispatch everything it owned.

@@ -556,6 +556,17 @@ class ObsServer:
             pools = []
         if pools:
             out["pools"] = pools
+        # Pod-scale serving fabric: one row per fabric host (replicas,
+        # queue depth, affinity hit rate) — the tfos-top --pods pane
+        # (same lazy pattern as actors/pools).
+        try:
+            from tensorflowonspark_tpu.serving.fabric import fabric_table
+
+            pods = fabric_table()
+        except Exception:  # noqa: BLE001 - routers tearing down
+            pods = []
+        if pods:
+            out["pods"] = pods
         # Blessed-checkpoint deployment loops: rollout state, watermark,
         # per-arm canary evidence (same lazy pattern as actors/pools).
         try:

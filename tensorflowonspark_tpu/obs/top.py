@@ -12,13 +12,15 @@ a single snapshot and exits (the form the fast-lane test drives).
 Usage::
 
     tfos-top [--url http://127.0.0.1:9090] [--interval 2] [--once]
-             [--slo] [--health]
+             [--slo] [--health] [--deploy] [--pods]
 
 ``--url`` defaults to ``http://127.0.0.1:$TFOS_OBS_PORT``.  ``--slo``
 appends the SLO pane (one row per objective from the ``slo`` section of
 ``/statusz``: tracked value, burn rate, breach flag — ``obs/slo.py``).
 ``--health`` appends the watchtower pane: per-node health state and
 anomaly counts plus the driver's straggler table (``obs/health.py``).
+``--pods`` appends the serving-fabric pane: one row per fabric host
+from the ``pods`` section of ``/statusz`` (``serving/fabric/``).
 """
 
 from __future__ import annotations
@@ -183,6 +185,36 @@ def render_health(status):
     return "\n".join(lines) + "\n"
 
 
+PODS_COLUMNS = (
+    # (header, width, extractor) over one /statusz "pods" row (a fabric
+    # host, serving/fabric/router.py describe())
+    ("HOST", 6, lambda r: f"{r.get('router', 0)}/{r.get('host', '?')}"),
+    ("UP", 4, lambda r: "yes" if r.get("alive") else "DOWN"),
+    ("PID", 8, lambda r: _num(r.get("pid"))),
+    ("REPLICAS", 9, lambda r: _num(r.get("replicas"))),
+    ("QDEPTH", 7, lambda r: _num(r.get("queue_depth"))),
+    ("VERSION", 8, lambda r: _num(r.get("version"))),
+    ("AFF-HIT%", 9, lambda r: _pct(r.get("affinity_hit_rate"))),
+)
+
+
+def render_pods(status):
+    """The --pods pane text: one row per serving-fabric host from the
+    ``/statusz`` pods section (serving/fabric/, docs/serving.md
+    "Pod-scale fabric")."""
+    lines = ["", "pods (serving/fabric/):"]
+    rows = status.get("pods") or []
+    if not rows:
+        lines.append("  (no fabric routers)")
+        return "\n".join(lines) + "\n"
+    lines.append(" ".join(h.ljust(w) for h, w, _ in PODS_COLUMNS).rstrip())
+    for row in rows:
+        lines.append(" ".join(
+            str(fn(row))[:w].ljust(w)
+            for _, w, fn in PODS_COLUMNS).rstrip())
+    return "\n".join(lines) + "\n"
+
+
 def render_deploy(status):
     """The --deploy pane text: per-loop rollout state from the
     ``/statusz`` deploy section (workloads/deploy_loop.py,
@@ -269,6 +301,9 @@ def build_parser():
     p.add_argument("--deploy", action="store_true",
                    help="append the deploy pane (rollout state, canary "
                         "arms, verdicts)")
+    p.add_argument("--pods", action="store_true",
+                   help="append the pods pane (serving-fabric hosts: "
+                        "replicas, queue depth, affinity hit rate)")
     return p
 
 
@@ -299,6 +334,8 @@ def main(argv=None, out=None):
             text += render_health(status)
         if args.deploy:
             text += render_deploy(status)
+        if args.pods:
+            text += render_pods(status)
         if args.once:
             out.write(text)
             out.flush()

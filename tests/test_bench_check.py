@@ -28,7 +28,7 @@ def _load():
     return mod
 
 
-def _line(img_s=None, p99=None, tok_s=None, value=0.4):
+def _line(img_s=None, p99=None, tok_s=None, fabric=None, value=0.4):
     extra = {}
     if img_s is not None:
         extra["images_per_sec_per_chip"] = img_s
@@ -36,6 +36,10 @@ def _line(img_s=None, p99=None, tok_s=None, value=0.4):
         extra["serve"] = {"p99_ms": p99, "req_per_sec": 900.0}
     if tok_s is not None:
         extra["transformer"] = {"tokens_per_sec_per_chip": tok_s}
+    if fabric is not None:
+        extra["serve_fabric"] = dict(
+            {"req_per_sec": 40.0, "p99_ms": 250.0, "dropped": 0,
+             "affinity_hit_rate": 0.5, "scale_ups": 2}, **fabric)
     return {"metric": "resnet_train_mfu", "value": value, "unit": "frac",
             "extra": extra}
 
@@ -148,6 +152,50 @@ def test_run_stamp_keys_are_ignored_by_lanes(tmp_path, monkeypatch):
     rc, out = _run(tmp_path, "--baseline", str(tmp_path / "old.json"),
                    "--latest", str(tmp_path / "new.json"))
     assert rc == 0, out
+
+
+def test_fabric_dropped_ceiling_is_pinned_at_zero(tmp_path):
+    """The fabric lane's zero-drop contract: any client-visible error
+    fails the gate even when the PRIOR round was just as bad (absolute
+    ceiling, not a trend)."""
+    _write(tmp_path, "BENCH_r01.json", _line(img_s=2500,
+                                             fabric={"dropped": 3}))
+    _write(tmp_path, "BENCH_r02.json", _line(img_s=2500,
+                                             fabric={"dropped": 3}))
+    rc, out = _run(tmp_path)
+    assert rc == 1
+    assert "fabric.dropped" in out and "above ceiling" in out
+    # dropped back at 0: the trend lanes take over and pass
+    _write(tmp_path, "BENCH_r03.json", _line(img_s=2500, fabric={}))
+    _write(tmp_path, "BENCH_r04.json", _line(img_s=2500, fabric={}))
+    rc, out = _run(tmp_path)
+    assert rc == 0, out
+
+
+def test_fabric_scale_ups_floor_and_p99_trend(tmp_path):
+    """scale_ups < 1 means the autoscaler never actuated — an absolute
+    floor on the newest line; it is NOT compared round-over-round (how
+    many steps the load shape needed is not a trend).  fabric.p99_ms
+    is a plain lower-is-better trend lane."""
+    _write(tmp_path, "BENCH_r01.json", _line(img_s=2500,
+                                             fabric={"scale_ups": 4}))
+    _write(tmp_path, "BENCH_r02.json", _line(img_s=2500,
+                                             fabric={"scale_ups": 0}))
+    rc, out = _run(tmp_path)
+    assert rc == 1
+    assert "fabric.scale_ups" in out and "below floor" in out
+    # fewer scale_ups than last round but >= 1: not a regression
+    _write(tmp_path, "BENCH_r03.json", _line(img_s=2500,
+                                             fabric={"scale_ups": 1}))
+    rc, out = _run(tmp_path)
+    assert rc == 0, out
+    assert "fabric.scale_ups" not in out
+    # p99 blowing up past tolerance IS one
+    _write(tmp_path, "BENCH_r04.json", _line(img_s=2500,
+                                             fabric={"p99_ms": 400.0}))
+    rc, out = _run(tmp_path)
+    assert rc == 1
+    assert "fabric.p99_ms" in out
 
 
 def test_real_repo_bench_files_are_comparable():

@@ -246,14 +246,15 @@ def test_route_empty_arm_degrades_not_drops():
 def test_accept_mirror_watermark_rule():
     from tensorflowonspark_tpu.serving import elastic as E
 
-    def accept(watermark, mirror, version):
+    def accept(watermark, mirror, version, reload_wm=None):
         pool = E.ElasticReplicaPool.__new__(E.ElasticReplicaPool)
         pool._lock = threading.Lock()
         pool._watermark = watermark
+        pool._reload_watermark = reload_wm
         pool._mirror_version = mirror
         return pool._accept_mirror(version)
 
-    # no watermark: plain latest-wins
+    # no watermark at all: plain latest-wins
     assert accept(None, None, 5)
     assert accept(None, 3, 5)
     assert not accept(None, 5, 3)
@@ -270,6 +271,12 @@ def test_accept_mirror_watermark_rule():
     assert accept(10, 12, 14)
     # a blessed sync pulls a candidate-tainted mirror back under the mark
     assert accept(10, 12, 8)
+    # no promotion watermark but the reload watcher broadcast step 10:
+    # the same rule applies against the hot-reload watermark, so a
+    # respawn's never-broadcast checkpoint can't displace the mirror
+    assert accept(None, 6, 8, reload_wm=10)
+    assert not accept(None, 8, 12, reload_wm=10)
+    assert accept(None, None, 12, reload_wm=10)
 
 
 # -- staged rollout end-to-end against a live pool ---------------------------

@@ -224,6 +224,16 @@ def test_fabric_predict_roundtrip_and_describe():
         rows = FR.fabric_table()
         assert {r["host"] for r in rows} == {0, 1}
         assert all(r["alive"] and r["replicas"] == 2 for r in rows)
+        # the rollup rides /statusz as the "pods" section (obs/http.py)
+        # and renders as the tfos-top --pods pane (obs/top.py)
+        from tensorflowonspark_tpu.obs import http as obs_http
+        from tensorflowonspark_tpu.obs import top as obs_top
+        obs = obs_http.ObsServer(cluster=None, port=0, interval=999)
+        statusz = obs.render_statusz()
+        assert {r["host"] for r in statusz["pods"]} == {0, 1}
+        pane = obs_top.render_pods(statusz)
+        assert "pods (serving/fabric/):" in pane
+        assert pane.count("yes") == 2
         st = srv.pool.stats(timeout=30)
         assert set(st) == {0, 1}
         assert all(len(v["workers"]) == 2 for v in st.values())

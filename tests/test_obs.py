@@ -376,6 +376,15 @@ _CANNED = {
          "target_pct": 99.0, "threshold_ms": 10.0, "current": None,
          "burn": None, "breaching": False, "samples": 0},
     ],
+    # a serving/fabric fabric_table() rollup, rendered only under --pods
+    "pods": [
+        {"router": 0, "host": 0, "alive": True, "pid": 4242,
+         "replicas": 3, "queue_depth": 2, "version": 7,
+         "affinity_hit_rate": 0.75},
+        {"router": 0, "host": 1, "alive": False, "pid": 4243,
+         "replicas": 0, "queue_depth": 0, "version": 7,
+         "affinity_hit_rate": 0.0},
+    ],
 }
 
 
@@ -446,6 +455,32 @@ def test_tfos_top_slo_pane():
         httpd.shutdown()
         httpd.server_close()
     assert "(no objectives reported)" in obs_top.render_slo({})
+
+
+def test_tfos_top_pods_pane():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _StatuszStub)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        out = io.StringIO()
+        assert obs_top.main(["--url", url, "--once", "--pods"],
+                            out=out) == 0
+        text = out.getvalue()
+        assert "pods (serving/fabric/):" in text
+        lines = text.splitlines()
+        (h0,) = [ln for ln in lines if ln.startswith("0/0")]
+        assert "yes" in h0 and "4242" in h0 and "75.0" in h0
+        (h1,) = [ln for ln in lines if ln.startswith("0/1")]
+        assert "DOWN" in h1
+        # without --pods the pane stays hidden
+        out2 = io.StringIO()
+        assert obs_top.main(["--url", url, "--once"], out=out2) == 0
+        assert "pods (serving/fabric/)" not in out2.getvalue()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    assert "(no fabric routers)" in obs_top.render_pods({})
 
 
 # --- slo engine (obs/slo.py) -------------------------------------------------
